@@ -1,0 +1,39 @@
+"""ipex_llm_tpu — a TPU-native LLM acceleration framework.
+
+Capability peer of the reference `ipex-llm` (Intel's low-bit LLM library for
+XPU/NPU/CPU, see /root/reference/python/llm/src/ipex_llm/__init__.py), rebuilt
+from scratch and idiomatically for TPU on JAX/XLA/Pallas:
+
+- block-quantized weights (INT4/INT5/INT8/NF4/NF3/FP4/FP6/FP8, GGUF k-quants)
+  stored as packed arrays in a JAX pytree (``QTensor``), instead of the
+  reference's ggml C blobs (reference: ggml/quantize.py, low_bit_linear.py);
+- a Pallas kernel library for the hot ops (fused dequant-matmul, flash SDPA
+  with fp8 KV, fused RoPE, RMS/LayerNorm, MoE routing) replacing the SYCL
+  ``xe_linear``/``xe_batch``/``xe_addons`` extensions (reference §2.3);
+- native JAX model definitions driven by HF checkpoints as a *weight source*
+  rather than monkey-patched torch forwards (reference: transformers/convert.py);
+- mesh-based tensor/pipeline/expert/context parallelism over ICI/DCN through
+  ``jax.sharding`` (replacing DeepSpeed-AutoTP + oneCCL, reference §2.2).
+
+Public API mirrors the reference's compatibility contract:
+
+    from ipex_llm_tpu import optimize_model
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="sym_int4")
+    out = model.generate(input_ids, max_new_tokens=32)
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["optimize_model", "load_low_bit", "low_memory_init", "__version__"]
+
+
+def __getattr__(name):
+    # lazy: keep `import ipex_llm_tpu` light (no jax trace-time cost) the way
+    # the reference keeps its top-level import side-effect free apart from the
+    # IPEX auto-import shim (reference: __init__.py:33-47).
+    if name in ("optimize_model", "load_low_bit", "low_memory_init"):
+        from ipex_llm_tpu import optimize
+
+        return getattr(optimize, name)
+    raise AttributeError(name)

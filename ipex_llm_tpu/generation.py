@@ -1,0 +1,309 @@
+"""Generation engine: bucketed prefill + fully-jitted decode loop.
+
+Reference counterpart: the patched ``GenerationMixin.generate`` stack
+(SURVEY.md §3.2) where Python drives the model token-by-token and every step
+is a separate kernel dispatch.  TPU-first design instead:
+
+- **prefill** pads the prompt batch into a length bucket (multiples of
+  ``BUCKET``) and runs one jitted forward; left-padding + ``kv_start`` masks
+  keep shapes static across ragged prompts (SURVEY.md §7 hard part (b));
+- **decode** is ONE jitted ``lax.while_loop`` that samples, appends to the KV
+  cache, and early-exits when every sequence hit EOS — zero host round-trips
+  until the whole generation finishes;
+- a **streaming** variant jits a single step and drives it from Python when
+  the caller needs tokens as they arrive (serving), trading a host sync per
+  token for latency visibility.
+
+Re-jit happens only when the (prompt bucket, capacity) pair changes, the
+moral equivalent of the reference re-allocating KV blocks of
+KV_ALLOC_BLOCK_LENGTH=256 (models/utils.py:39-75).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu import kv as kv_mod
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.decoder import decoder_forward
+from ipex_llm_tpu.ops.sampling import SamplingParams, sample
+
+BUCKET = 128          # prompt-length bucket granularity
+DECODE_BLOCK = 256    # KV capacity granularity (reference KV_ALLOC_BLOCK_LENGTH)
+REP_WINDOW = 512      # repetition-penalty lookback ring size
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    """HF-compatible knobs (the subset the reference's benchmarks exercise)."""
+
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: tuple[int, ...] = ()
+    pad_token_id: int = 0
+    seed: int = 0
+
+    def sampling(self) -> SamplingParams:
+        return SamplingParams(
+            temperature=self.temperature,
+            top_k=self.top_k,
+            top_p=self.top_p,
+            repetition_penalty=self.repetition_penalty,
+            do_sample=self.do_sample,
+        )
+
+
+@dataclass
+class GenerateResult:
+    sequences: np.ndarray          # [B, prompt+new] right-trimmed at pad
+    num_prompt_tokens: int
+    num_new_tokens: np.ndarray     # [B]
+    first_token_s: float = 0.0     # TTFT (prefill + first sample)
+    rest_token_s: float = 0.0      # mean per-token latency after the first
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def pad_batch(
+    input_ids: Any, pad_id: int, bucket: int = BUCKET
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Left-pad a ragged (or rectangular) batch into a bucketed array.
+
+    Returns (tokens [B, Tpad], lengths [B], Tpad).
+    """
+    if isinstance(input_ids, np.ndarray) and input_ids.ndim == 2:
+        rows = list(input_ids)
+    elif hasattr(input_ids, "tolist") and getattr(input_ids, "ndim", 1) == 2:
+        rows = [np.asarray(r) for r in np.asarray(input_ids)]
+    else:
+        rows = [np.asarray(r).reshape(-1) for r in input_ids]
+    lens = np.array([len(r) for r in rows], dtype=np.int32)
+    tpad = _round_up(max(int(lens.max()), 1), bucket)
+    out = np.full((len(rows), tpad), pad_id, dtype=np.int32)
+    for i, r in enumerate(rows):
+        out[i, tpad - len(r):] = r
+    return out, lens, tpad
+
+
+# ---------------------------------------------------------------------------
+# jitted stages
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def prefill_step(
+    cfg: ModelConfig,
+    params: dict,
+    cache,
+    tokens: jnp.ndarray,      # [B, Tpad] left-padded
+    lengths: jnp.ndarray,     # [B]
+):
+    """Run the prompt through the decoder; returns (last_logits [B,V], cache)."""
+    b, tpad = tokens.shape
+    kv_start = (tpad - lengths).astype(jnp.int32)
+    # logical positions: 0..len-1 right-aligned, clipped at 0 in the pad zone
+    pos = jnp.maximum(jnp.arange(tpad)[None, :] - kv_start[:, None], 0)
+    logits, cache = decoder_forward(
+        cfg, params, tokens, cache, pos, kv_start=kv_start, last_token_only=True
+    )
+    return logits, cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "gen", "max_steps"),
+    donate_argnums=(2,),
+)
+def decode_loop(
+    cfg: ModelConfig,
+    params: dict,
+    cache,
+    first_tokens: jnp.ndarray,   # [B] token sampled from prefill
+    lengths: jnp.ndarray,        # [B] prompt lengths
+    kv_start: jnp.ndarray,       # [B]
+    prev_ring: jnp.ndarray,      # [B, REP_WINDOW] int32 (-1 pad) rep-penalty ring
+    key: jax.Array,
+    gen: GenerationConfig,
+    max_steps: int,
+):
+    """Whole decode loop in one XLA program with EOS early-exit.
+
+    Returns (tokens [B, max_steps], n_done_steps, cache).
+    """
+    b = first_tokens.shape[0]
+    sp = gen.sampling()
+    eos = jnp.asarray(gen.eos_token_id, jnp.int32) if gen.eos_token_id else None
+
+    out_buf = jnp.full((b, max_steps), gen.pad_token_id, jnp.int32)
+    out_buf = out_buf.at[:, 0].set(first_tokens)
+    done0 = jnp.zeros((b,), bool)
+    if eos is not None:
+        done0 = (first_tokens[:, None] == eos[None, :]).any(axis=1)
+
+    def cond(state):
+        step, _, _, _, done, _, _ = state
+        return (step < max_steps) & ~done.all()
+
+    def body(state):
+        step, tok, cache, key, done, prev, out = state
+        pos = lengths + step - 1            # logical position of `tok`
+        logits, cache = decoder_forward(
+            cfg, params, tok[:, None], cache,
+            pos[:, None], kv_start=kv_start, last_token_only=True,
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub, sp, prev if sp.repetition_penalty != 1.0 else None)
+        nxt = jnp.where(done, gen.pad_token_id, nxt)
+        if eos is not None:
+            done = done | (nxt[:, None] == eos[None, :]).any(axis=1)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, step))
+        prev = jax.lax.dynamic_update_slice(
+            prev, nxt[:, None], (0, (lengths[0] + step) % REP_WINDOW)
+        )
+        return step + 1, nxt, cache, key, done, prev, out
+
+    state = (jnp.asarray(1, jnp.int32), first_tokens, cache, key, done0,
+             prev_ring, out_buf)
+    step, _, cache, _, done, _, out = jax.lax.while_loop(cond, body, state)
+    return out, step, cache
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _init_prev_ring(tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Seed the repetition-penalty ring with the prompt tail."""
+    b, tpad = tokens.shape
+    ring = np.full((b, REP_WINDOW), -1, dtype=np.int32)
+    for i in range(b):
+        tail = tokens[i, tpad - lengths[i]:][-REP_WINDOW:]
+        ring[i, : len(tail)] = tail
+    return ring
+
+
+def generate(
+    cfg: ModelConfig,
+    params: dict,
+    input_ids: Any,
+    generation_config: GenerationConfig,
+    kv_kind: str = "auto",
+    streamer: Callable[[np.ndarray], None] | None = None,
+) -> GenerateResult:
+    """End-to-end generate.  ``input_ids``: list of token lists or [B, T] array.
+
+    When ``streamer`` is given, decode runs step-by-step from Python (one host
+    sync per token) and the callback receives each new token row [B].
+    """
+    gen = generation_config
+    tokens, lengths, tpad = pad_batch(input_ids, gen.pad_token_id)
+    b = tokens.shape[0]
+    capacity = tpad + _round_up(gen.max_new_tokens + 1, DECODE_BLOCK)
+
+    if kv_kind == "auto":
+        kv_kind = (
+            "fp8"
+            if kv_mod.use_quantize_kv_cache(cfg.num_heads, cfg.num_kv_heads)
+            else "normal"
+        )
+    cache = kv_mod.make_cache(
+        kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
+    )
+
+    t0 = time.perf_counter()
+    lengths_j = jnp.asarray(lengths)
+    logits, cache = prefill_step(cfg, params, cache, jnp.asarray(tokens), lengths_j)
+    key = jax.random.PRNGKey(gen.seed)
+    key, sub = jax.random.split(key)
+    prev_ring = jnp.asarray(_init_prev_ring(tokens, lengths))
+    first = sample(
+        logits, sub, gen.sampling(),
+        prev_ring if gen.repetition_penalty != 1.0 else None,
+    )
+    first.block_until_ready()
+    ttft = time.perf_counter() - t0
+
+    kv_start = jnp.asarray((tpad - lengths).astype(np.int32))
+    t1 = time.perf_counter()
+    if streamer is None:
+        out, steps, cache = decode_loop(
+            cfg, params, cache, first, lengths_j, kv_start, prev_ring, key,
+            gen, gen.max_new_tokens,
+        )
+        out = np.asarray(out)
+        steps = int(steps)
+    else:
+        out, steps = _stream_decode(
+            cfg, params, cache, first, lengths_j, kv_start, prev_ring, key,
+            gen, streamer,
+        )
+    dt = time.perf_counter() - t1
+
+    eos_set = set(gen.eos_token_id)
+    new_counts = np.zeros((b,), np.int32)
+    for i in range(b):
+        n = 0
+        for t in out[i, :steps]:
+            n += 1
+            if int(t) in eos_set:
+                break
+        new_counts[i] = n
+    seqs = np.concatenate([tokens[:, tpad - lengths.max():], out[:, :steps]], axis=1)
+    return GenerateResult(
+        sequences=seqs,
+        num_prompt_tokens=int(lengths.max()),
+        num_new_tokens=new_counts,
+        first_token_s=ttft,
+        rest_token_s=dt / max(steps - 1, 1),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
+def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, key, gen: GenerationConfig):
+    logits, cache = decoder_forward(
+        cfg, params, tok[:, None], cache, pos[:, None],
+        kv_start=kv_start, last_token_only=True,
+    )
+    key, sub = jax.random.split(key)
+    sp = gen.sampling()
+    nxt = sample(logits, sub, sp, prev if sp.repetition_penalty != 1.0 else None)
+    return nxt, cache, key
+
+
+def _stream_decode(cfg, params, cache, first, lengths, kv_start, prev_ring,
+                   key, gen: GenerationConfig, streamer):
+    b = first.shape[0]
+    eos_set = set(gen.eos_token_id)
+    out = np.full((b, gen.max_new_tokens), gen.pad_token_id, np.int32)
+    out[:, 0] = np.asarray(first)
+    streamer(out[:, 0])
+    done = np.array([int(t) in eos_set for t in out[:, 0]])
+    tok = first
+    step = 1
+    while step < gen.max_new_tokens and not done.all():
+        pos = lengths + step - 1
+        tok, cache, key = _decode_one(
+            cfg, params, cache, tok, pos, kv_start, prev_ring, key, gen
+        )
+        row = np.asarray(tok)
+        row = np.where(done, gen.pad_token_id, row)
+        out[:, step] = row
+        streamer(row)
+        done |= np.isin(row, list(eos_set)) if eos_set else False
+        tok = jnp.asarray(row)
+        step += 1
+    return out, step

@@ -1,0 +1,128 @@
+"""KV caches.
+
+Reference counterparts: ``DynamicNormalCache`` / ``DynamicFp8Cache`` /
+``DynamicCompressCache`` (reference kv.py:33,79,296) and the alloc/append
+helpers of models/utils.py:39-75.  The reference grows torch buffers in
+KV_ALLOC_BLOCK_LENGTH=256 chunks because eager PyTorch allows dynamic shapes;
+under XLA every shape must be static, so the TPU-native design is:
+
+- one pre-allocated ring of shape ``[L, B, S_max, Hkv, D]`` per k/v,
+- an integer ``length`` scalar tracking the filled prefix,
+- updates via ``lax.dynamic_update_slice`` inside the jitted step,
+- capacity chosen by the generate loop from bucketed prompt+max_new lengths
+  (re-jit only when the bucket changes, like the reference re-allocs).
+
+``Fp8KVCache`` stores e5m2 codes (uint8) — the same format the reference's
+fp8 cache uses (models/utils.py:102-192) — halving KV HBM traffic; dequant
+happens next to the attention op (in-kernel for the Pallas path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class KVCache:
+    """Static-shape stacked-layer KV cache (the DynamicNormalCache peer)."""
+
+    k: jnp.ndarray  # [L, B, S_max, Hkv, D] storage dtype (bf16)
+    v: jnp.ndarray
+    length: jnp.ndarray  # scalar int32: filled prefix length
+
+    storage: str = "bf16"  # static: bf16 | fp8
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), (self.storage,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, length = children
+        return cls(k, v, length, storage=aux[0])
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def init(cls, n_layers: int, batch: int, max_len: int, n_kv_heads: int,
+             head_dim: int, dtype=jnp.bfloat16, v_head_dim: int | None = None):
+        vd = v_head_dim if v_head_dim is not None else head_dim
+        return cls(
+            k=jnp.zeros((n_layers, batch, max_len, n_kv_heads, head_dim), dtype),
+            v=jnp.zeros((n_layers, batch, max_len, n_kv_heads, vd), dtype),
+            length=jnp.zeros((), jnp.int32),
+            storage="bf16",
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    # -- per-layer access (used inside the layer scan) ----------------------
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(self.k.dtype)
+
+    def decode_layer(self, kl: jnp.ndarray, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+        return kl.astype(compute_dtype)
+
+    def update_layer(self, kl: jnp.ndarray, vl: jnp.ndarray,
+                     new_k: jnp.ndarray, new_v: jnp.ndarray, pos: jnp.ndarray):
+        """Write new_k/new_v [B, T, H, D] into layer slices at offset pos."""
+        kl = jax.lax.dynamic_update_slice(kl, self.encode(new_k), (0, pos, 0, 0))
+        vl = jax.lax.dynamic_update_slice(vl, self.encode(new_v), (0, pos, 0, 0))
+        return kl, vl
+
+    def advanced(self, n: int | jnp.ndarray) -> "KVCache":
+        return replace(self, length=self.length + n)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Fp8KVCache(KVCache):
+    """fp8(e5m2) KV storage (DynamicFp8Cache peer, reference kv.py:33)."""
+
+    @classmethod
+    def init(cls, n_layers: int, batch: int, max_len: int, n_kv_heads: int,
+             head_dim: int, dtype=jnp.bfloat16, v_head_dim: int | None = None):
+        vd = v_head_dim if v_head_dim is not None else head_dim
+        return cls(
+            k=jnp.zeros((n_layers, batch, max_len, n_kv_heads, head_dim),
+                        jnp.float8_e5m2),
+            v=jnp.zeros((n_layers, batch, max_len, n_kv_heads, vd), jnp.float8_e5m2),
+            length=jnp.zeros((), jnp.int32),
+            storage="fp8",
+        )
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(jnp.float8_e5m2)
+
+    def decode_layer(self, kl: jnp.ndarray, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+        return kl.astype(compute_dtype)
+
+
+def make_cache(kind: str, *args: Any, **kwargs: Any) -> KVCache:
+    """kind: 'normal' | 'fp8' (compress/SnapKV variant: see ipex_llm_tpu.compresskv)."""
+    if kind == "normal":
+        return KVCache.init(*args, **kwargs)
+    if kind == "fp8":
+        return Fp8KVCache.init(*args, **kwargs)
+    raise ValueError(f"unknown kv cache kind {kind!r}")
+
+
+def use_quantize_kv_cache(n_heads: int, n_kv_heads: int, env: str | None = None) -> bool:
+    """Heuristic gate for fp8 KV (reference models/utils.py:77: env override,
+    else enable for GQA models where KV is the decode bottleneck)."""
+    import os
+
+    flag = os.environ.get("IPEX_LLM_TPU_QUANTIZE_KV_CACHE",
+                          os.environ.get("IPEX_LLM_QUANTIZE_KV_CACHE", ""))
+    if flag == "1":
+        return True
+    if flag == "0":
+        return False
+    return n_kv_heads > 0 and n_heads // max(n_kv_heads, 1) >= 4

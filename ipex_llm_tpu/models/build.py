@@ -1,0 +1,156 @@
+"""Build the decoder param pytree from a weight source.
+
+Replaces the reference's ``ggml_convert_low_bit`` module-tree walk
+(convert.py:1092, ``_replace_with_low_bit_linear`` convert.py:472): instead of
+mutating a torch model in place, we *construct* the JAX param pytree directly
+from any name->tensor source (safetensors reader, a torch state_dict, a GGUF
+file), merging QKV / gate-up before quantization and stacking layers for the
+scan-based decoder.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.families import WeightScheme
+from ipex_llm_tpu.quantize import core as qcore
+from ipex_llm_tpu.quantize.core import QTensor
+
+NORM_DTYPE = jnp.float32
+
+
+def quantize_weight(w: np.ndarray, qtype: str) -> QTensor:
+    """Quantize one HF-layout [out, in] weight to a [in, out] QTensor.
+
+    ``mixed_fp4``/``mixed_fp8`` implement the reference's
+    Mixture-of-Formats policy (ggml/quantize.py:36-37): try the float format
+    and the int format, keep whichever reconstructs this tensor better.
+    """
+    wt = np.ascontiguousarray(w.T)
+    if qtype in ("mixed_fp4", "mixed_fp8"):
+        fp = "fp4" if qtype == "mixed_fp4" else "fp8_e4m3"
+        alt = "sym_int4" if qtype == "mixed_fp4" else "sym_int8"
+        cand = []
+        for q in (fp, alt):
+            qt = qcore.quantize(wt, q)
+            err = float(
+                jnp.mean((qcore.dequantize(qt) - jnp.asarray(wt)) ** 2)
+            )
+            cand.append((err, qt))
+        return min(cand, key=lambda c: c[0])[1]
+    return qcore.quantize(wt, qtype)
+
+
+def stack_layer_trees(trees: list[dict[str, Any]]) -> dict[str, Any]:
+    """Stack per-layer pytrees (QTensor-aware) along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def build_params(
+    cfg: ModelConfig,
+    scheme: WeightScheme,
+    get: Callable[[str], np.ndarray],
+    has: Callable[[str], bool],
+    qtype: str = "sym_int4",
+    lm_head_qtype: str | None = None,
+    mixed_precision: bool = False,
+    progress: Callable[[str], None] | None = None,
+) -> dict[str, Any]:
+    """Assemble the full decoder param pytree, quantizing as it streams.
+
+    mixed_precision mirrors the reference's flag (model.py kwargs): quantize
+    the lm_head at sym_int8 rather than the 4-bit body qtype.
+    """
+
+    def name(t: str | None, i: int | None = None, p: str = "weight") -> str | None:
+        if t is None:
+            return None
+        return t.format(i=i, p=p)
+
+    def get_opt(n: str | None) -> np.ndarray | None:
+        if n is None or not has(n):
+            return None
+        return get(n)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        if progress:
+            progress(f"layer {i + 1}/{cfg.num_layers}")
+        lp: dict[str, Any] = {}
+        lp["attn_norm"] = jnp.asarray(get(name(scheme.attn_norm, i)), NORM_DTYPE)
+        lp["mlp_norm"] = jnp.asarray(get(name(scheme.mlp_norm, i)), NORM_DTYPE)
+        for key, tmpl in (
+            ("post_attn_norm", scheme.post_attn_norm),
+            ("post_mlp_norm", scheme.post_mlp_norm),
+            ("q_norm", scheme.q_norm),
+            ("k_norm", scheme.k_norm),
+        ):
+            t = get_opt(name(tmpl, i))
+            if t is not None:
+                lp[key] = jnp.asarray(t, NORM_DTYPE)
+
+        # --- qkv (merge like reference _optimize_pre merge_qkv, convert.py:890)
+        if scheme.qkv is not None:
+            qkv_w = get(name(scheme.qkv, i))
+            qkv_b = get_opt(name(scheme.qkv, i, "bias"))
+        else:
+            qw = get(name(scheme.q, i))
+            kw = get(name(scheme.k, i))
+            vw = get(name(scheme.v, i))
+            qkv_w = np.concatenate([qw, kw, vw], axis=0)  # [out_total, in]
+            bs = [get_opt(name(t, i, "bias")) for t in (scheme.q, scheme.k, scheme.v)]
+            qkv_b = np.concatenate(bs) if bs[0] is not None else None
+        lp["qkv"] = quantize_weight(qkv_w, qtype)
+        if qkv_b is not None:
+            lp["qkv_bias"] = jnp.asarray(qkv_b, jnp.float32)
+
+        ow = get(name(scheme.o, i))
+        lp["o"] = quantize_weight(ow, qtype)
+        ob = get_opt(name(scheme.o, i, "bias"))
+        if ob is not None:
+            lp["o_bias"] = jnp.asarray(ob, jnp.float32)
+
+        # --- mlp (merged gate_up)
+        if scheme.gate_up is not None:
+            gu_w = get(name(scheme.gate_up, i))
+            gu_b = get_opt(name(scheme.gate_up, i, "bias"))
+        else:
+            gw = get(name(scheme.gate, i))
+            uw = get(name(scheme.up, i))
+            gu_w = np.concatenate([gw, uw], axis=0)
+            gb = get_opt(name(scheme.gate, i, "bias"))
+            ub = get_opt(name(scheme.up, i, "bias"))
+            gu_b = np.concatenate([gb, ub]) if gb is not None else None
+        lp["gate_up"] = quantize_weight(gu_w, qtype)
+        if gu_b is not None:
+            lp["gate_up_bias"] = jnp.asarray(gu_b, jnp.float32)
+        lp["down"] = quantize_weight(get(name(scheme.down, i)), qtype)
+        db = get_opt(name(scheme.down, i, "bias"))
+        if db is not None:
+            lp["down_bias"] = jnp.asarray(db, jnp.float32)
+        layers.append(lp)
+
+    params: dict[str, Any] = {"layers": stack_layer_trees(layers)}
+    params["embed"] = jnp.asarray(get(scheme.embed), jnp.bfloat16)
+    params["final_norm"] = jnp.asarray(get(scheme.final_norm), NORM_DTYPE)
+
+    if cfg.tie_word_embeddings:
+        pass  # decoder uses embed.T
+    else:
+        head_q = lm_head_qtype or ("sym_int8" if mixed_precision else qtype)
+        lm_w = get(scheme.lm_head)
+        # reference is_lm_head mixed-precision rule (convert.py:126): keep
+        # big-vocab heads at >=8 bit when mixed_precision is requested
+        params["lm_head"] = quantize_weight(lm_w, head_q)
+
+    if cfg.rope is not None:
+        params["inv_freq"] = jnp.asarray(
+            cfg.rope.inv_freq(cfg.max_position_embeddings), jnp.float32
+        )
+        params["rope_mscale"] = float(cfg.rope.mscale(cfg.max_position_embeddings))
+    return params

@@ -1,0 +1,82 @@
+"""Normalized decoder configuration.
+
+Replaces the reference's strategy of monkey-patching 49 per-architecture HF
+modules (transformers/models/*.py, dispatched by convert.py:1275's 79
+``model_type`` branches) with ONE shared decoder core driven by a normalized
+config.  Each supported HF architecture contributes only a small mapping from
+its HF ``config.json`` to this dataclass plus a weight-name table
+(ipex_llm_tpu/models/families.py) — the SURVEY.md §7 mitigation for matching
+the reference's breadth without 49 forks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ipex_llm_tpu.ops.rope import RopeScaling
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    model_type: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_position_embeddings: int = 4096
+    act: str = "silu"
+
+    # norms
+    norm_eps: float = 1e-5
+    norm_kind: str = "rms"        # rms | layer
+    norm_offset: float = 0.0      # 1.0 for gemma-style (1+w)
+    qk_norm: bool = False         # qwen3/gemma3 per-head q/k rmsnorm
+    post_attn_norm: bool = False  # gemma2 extra post-attention norm
+    post_mlp_norm: bool = False
+
+    # rope
+    rope: RopeScaling | None = None
+    rope_layout: str = "half"     # half | two
+    partial_rotary: float = 1.0
+
+    # projections
+    attention_bias: bool = False
+    attention_out_bias: bool = False
+    mlp_bias: bool = False
+    tie_word_embeddings: bool = False
+
+    # attention extras
+    sliding_window: int | None = None
+    layer_types: tuple[str, ...] | None = None  # per-layer 'full'|'sliding'
+    attn_softcap: float | None = None           # gemma2 attn logit softcap
+    logit_softcap: float | None = None          # gemma2 final logit softcap
+    attn_scale: float | None = None             # override 1/sqrt(d)
+    embedding_multiplier: float = 1.0           # gemma sqrt(hidden)
+
+    # MoE (mixtral / qwen-moe / deepseek-style)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    num_shared_experts: int = 0
+    moe_norm_topk_prob: bool = False
+    moe_layer_start: int = 0        # deepseek: first k layers dense
+    moe_router_scale: float = 1.0
+
+    def layer_is_sliding(self, layer_idx: int) -> bool:
+        if self.layer_types is not None:
+            return self.layer_types[layer_idx] == "sliding_attention"
+        return self.sliding_window is not None
+
+    def layer_is_moe(self, layer_idx: int) -> bool:
+        return self.num_experts > 0 and layer_idx >= self.moe_layer_start
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim

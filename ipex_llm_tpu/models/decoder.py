@@ -1,0 +1,171 @@
+"""Shared transformer decoder core.
+
+This single functional decoder replaces the reference's per-model patched
+forwards (transformers/models/llama.py:56-205 and 48 sibling files): merged
+QKV / gate-up projections (the `_optimize_pre` merges, convert.py:890) are
+done once at weight-load time, and the per-layer loop is a ``lax.scan`` over
+stacked layer params so XLA compiles ONE layer body regardless of depth.
+
+Static-shape discipline (SURVEY.md §7 hard part (b)):
+- the KV cache is a fixed ``[L, B, S_max, H, D]`` ring (see kv.py),
+- prompts are left-padded into buckets; RoPE uses logical positions while
+  cache slots use physical indices, so decode writes are a single
+  ``dynamic_update_slice`` at a uniform offset for the whole batch,
+- per-layer sliding-window choice (gemma2-style alternation) enters the scan
+  as a traced flag folded into the attention mask, not Python control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops import rope as rope_ops
+from ipex_llm_tpu.ops.attention import sdpa
+from ipex_llm_tpu.ops.norms import layer_norm, rms_norm
+
+COMPUTE_DTYPE = jnp.bfloat16
+
+
+def _norm(x, w, cfg: ModelConfig, bias=None):
+    if cfg.norm_kind == "layer":
+        return layer_norm(x, w, bias, cfg.norm_eps)
+    return rms_norm(x, w, cfg.norm_eps, cfg.norm_offset)
+
+
+def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
+                     q_slots, kv_len, kv_start, sliding, cache: KVCache):
+    b, t, _ = x.shape
+    h = _norm(x, lp["attn_norm"], cfg)
+    qkv = linear_ops.linear(h, lp["qkv"], lp.get("qkv_bias"))
+    q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
+    q = qkv[..., :q_dim].reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = qkv[..., q_dim : q_dim + kv_dim].reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    v = qkv[..., q_dim + kv_dim :].reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_offset)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_offset)
+
+    rd = cfg.rope.rotary_dim if cfg.rope is not None else cfg.head_dim
+    if cfg.rope is not None:
+        if rd == cfg.head_dim:
+            q = rope_ops.apply_rope(q, cos, sin, cfg.rope_layout)
+            k = rope_ops.apply_rope(k, cos, sin, cfg.rope_layout)
+        else:  # partial rotary (phi / gptneox style)
+            q = jnp.concatenate(
+                [rope_ops.apply_rope(q[..., :rd], cos, sin, cfg.rope_layout), q[..., rd:]],
+                axis=-1,
+            )
+            k = jnp.concatenate(
+                [rope_ops.apply_rope(k[..., :rd], cos, sin, cfg.rope_layout), k[..., rd:]],
+                axis=-1,
+            )
+
+    kl, vl = cache.update_layer(kl, vl, k, v, slot0)
+    kd = cache.decode_layer(kl, COMPUTE_DTYPE)
+    vd = cache.decode_layer(vl, COMPUTE_DTYPE)
+
+    attn = sdpa(
+        q,
+        kd,
+        vd,
+        causal=True,
+        q_positions=q_slots,
+        kv_len=kv_len,
+        kv_start=kv_start,
+        window=cfg.sliding_window,
+        window_on=sliding,
+        softcap=cfg.attn_softcap,
+        scale=cfg.attn_scale,
+    )
+    attn = attn.reshape(b, t, cfg.num_heads * cfg.head_dim)
+    out = linear_ops.linear(attn, lp["o"], lp.get("o_bias"))
+    if cfg.post_attn_norm:
+        out = _norm(out, lp["post_attn_norm"], cfg)
+    return out, kl, vl
+
+
+def _mlp_block(cfg: ModelConfig, lp: dict, x):
+    h = _norm(x, lp["mlp_norm"], cfg)
+    gate_up = linear_ops.linear(h, lp["gate_up"], lp.get("gate_up_bias"))
+    gate, up = mlp_ops.split_gate_up(gate_up)
+    inner = mlp_ops.gated_act_mul(gate, up, cfg.act)
+    out = linear_ops.linear(inner, lp["down"], lp.get("down_bias"))
+    if cfg.post_mlp_norm:
+        out = _norm(out, lp["post_mlp_norm"], cfg)
+    return out
+
+
+def decoder_forward(
+    cfg: ModelConfig,
+    params: dict[str, Any],
+    tokens: jnp.ndarray,            # [B, T] int32
+    cache: KVCache,
+    rope_positions: jnp.ndarray,    # [B, T] logical positions (left-pad aware)
+    kv_start: jnp.ndarray | None = None,  # [B] first valid cache slot
+    last_token_only: bool = False,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the decoder; returns (logits, updated cache).
+
+    logits: [B, V] if last_token_only else [B, T, V].
+    """
+    b, t = tokens.shape
+    embed = params["embed"]
+    x = jnp.take(embed, tokens, axis=0).astype(COMPUTE_DTYPE)
+    if cfg.embedding_multiplier != 1.0:
+        x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
+
+    cos, sin = (None, None)
+    if cfg.rope is not None:
+        cos, sin = rope_ops.cos_sin(
+            rope_positions, params["inv_freq"], params.get("rope_mscale", 1.0)
+        )
+
+    slot0 = cache.length
+    q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
+    kv_len = jnp.broadcast_to(slot0 + t, (b,))
+
+    sliding_flags = jnp.array(
+        [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
+    )
+
+    def body(x, xs):
+        lp, kl, vl, sliding = xs
+        attn_out, kl, vl = _attention_block(
+            cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
+            sliding, cache,
+        )
+        x = x + attn_out
+        x = x + _mlp_block(cfg, lp, x)
+        return x, (kl, vl)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v, sliding_flags)
+    )
+
+    x = _norm(x, params["final_norm"], cfg)
+
+    if last_token_only:
+        x = x[:, -1, :]  # left-padding puts every sequence's last token at T-1
+
+    lm_head = params.get("lm_head")
+    if lm_head is None:  # tied embeddings
+        logits = jnp.matmul(
+            x.astype(COMPUTE_DTYPE), embed.T.astype(COMPUTE_DTYPE),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = linear_ops.linear(x, lm_head).astype(jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+
+    new_cache = replace(cache, k=k_new, v=v_new, length=slot0 + t)
+    return logits.astype(jnp.float32), new_cache

@@ -1,0 +1,195 @@
+"""Model-family registry: HF config → ModelConfig + weight naming scheme.
+
+This is the TPU-native replacement for the reference's per-``model_type``
+dispatch (convert.py:1275 ``_optimize_post``, 79 branches) and per-model
+``merge_qkv`` rewrites (`_optimize_pre`, convert.py:890): each family is a
+small declarative entry — config normalization plus weight-name templates —
+feeding the ONE shared decoder (models/decoder.py).  QKV and gate/up merges
+happen here at load time, before quantization, so each transformer layer runs
+exactly three quantized matmuls plus attention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.ops.rope import RopeScaling
+
+
+@dataclass(frozen=True)
+class WeightScheme:
+    """Weight-name templates ({i} = layer index). None = not present."""
+
+    embed: str = "model.embed_tokens.weight"
+    final_norm: str = "model.norm.weight"
+    lm_head: str = "lm_head.weight"
+    attn_norm: str = "model.layers.{i}.input_layernorm.weight"
+    mlp_norm: str = "model.layers.{i}.post_attention_layernorm.weight"
+    post_attn_norm: str | None = None
+    post_mlp_norm: str | None = None
+    q: str | None = "model.layers.{i}.self_attn.q_proj.{p}"
+    k: str | None = "model.layers.{i}.self_attn.k_proj.{p}"
+    v: str | None = "model.layers.{i}.self_attn.v_proj.{p}"
+    qkv: str | None = None      # pre-merged (phi3 / chatglm / baichuan W_pack)
+    o: str = "model.layers.{i}.self_attn.o_proj.{p}"
+    gate: str | None = "model.layers.{i}.mlp.gate_proj.{p}"
+    up: str | None = "model.layers.{i}.mlp.up_proj.{p}"
+    gate_up: str | None = None  # pre-merged (phi3)
+    down: str = "model.layers.{i}.mlp.down_proj.{p}"
+    q_norm: str | None = None
+    k_norm: str | None = None
+
+
+@dataclass(frozen=True)
+class Family:
+    name: str
+    to_config: Callable[[dict], ModelConfig]
+    scheme: WeightScheme = field(default_factory=WeightScheme)
+
+
+def _rope_from_hf(hf: dict, head_dim: int) -> RopeScaling:
+    rs = hf.get("rope_scaling") or {}
+    kind = rs.get("rope_type", rs.get("type", "default"))
+    return RopeScaling(
+        head_dim=head_dim,
+        base=hf.get("rope_theta", 10000.0),
+        kind=kind,
+        factor=rs.get("factor", 1.0),
+        low_freq_factor=rs.get("low_freq_factor", 1.0),
+        high_freq_factor=rs.get("high_freq_factor", 4.0),
+        original_max_position=rs.get(
+            "original_max_position_embeddings",
+            hf.get("original_max_position_embeddings",
+                   hf.get("max_position_embeddings", 8192)),
+        ),
+        partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
+        attention_factor=rs.get("attention_factor"),
+        short_factor=tuple(rs["short_factor"]) if rs.get("short_factor") else None,
+        long_factor=tuple(rs["long_factor"]) if rs.get("long_factor") else None,
+    )
+
+
+def _base_cfg(hf: dict, **over) -> dict:
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    d = dict(
+        model_type=hf.get("model_type", "llama"),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        max_position_embeddings=hf.get("max_position_embeddings", 4096),
+        act=hf.get("hidden_act", "silu"),
+        norm_eps=hf.get("rms_norm_eps", hf.get("layer_norm_eps", 1e-5)),
+        rope=_rope_from_hf(hf, head_dim),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=hf.get("attention_bias", False),
+        mlp_bias=hf.get("mlp_bias", False),
+    )
+    d.update(over)
+    return d
+
+
+def _llama(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(hf))
+
+
+def _mistral(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(hf, sliding_window=hf.get("sliding_window")))
+
+
+def _qwen2(hf: dict) -> ModelConfig:
+    # qwen2 has attention bias on qkv but not on o_proj
+    return ModelConfig(**_base_cfg(hf, attention_bias=True, attention_out_bias=False))
+
+
+def _qwen3(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(hf, qk_norm=True))
+
+
+def _phi3(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(hf, sliding_window=hf.get("sliding_window")))
+
+
+def _gemma(hf: dict) -> ModelConfig:
+    d = _base_cfg(
+        hf,
+        norm_offset=1.0,
+        act=hf.get("hidden_activation", hf.get("hidden_act", "gelu_pytorch_tanh")),
+        embedding_multiplier=float(np.sqrt(hf["hidden_size"])),
+        tie_word_embeddings=True,
+    )
+    return ModelConfig(**d)
+
+
+def _gemma2(hf: dict) -> ModelConfig:
+    n_layers = hf["num_hidden_layers"]
+    d = _base_cfg(
+        hf,
+        norm_offset=1.0,
+        act=hf.get("hidden_activation", "gelu_pytorch_tanh"),
+        embedding_multiplier=float(np.sqrt(hf["hidden_size"])),
+        tie_word_embeddings=True,
+        post_attn_norm=True,
+        post_mlp_norm=True,
+        attn_softcap=hf.get("attn_logit_softcapping", 50.0),
+        logit_softcap=hf.get("final_logit_softcapping", 30.0),
+        sliding_window=hf.get("sliding_window", 4096),
+        # gemma2 alternates sliding (even) / full (odd) attention layers
+        layer_types=tuple(
+            "sliding_attention" if i % 2 == 0 else "full_attention"
+            for i in range(n_layers)
+        ),
+        attn_scale=hf.get("query_pre_attn_scalar", hf["hidden_size"] //
+                          hf["num_attention_heads"]) ** -0.5,
+    )
+    return ModelConfig(**d)
+
+
+_GEMMA_SCHEME = WeightScheme(lm_head="model.embed_tokens.weight")
+_GEMMA2_SCHEME = WeightScheme(
+    lm_head="model.embed_tokens.weight",
+    mlp_norm="model.layers.{i}.pre_feedforward_layernorm.weight",
+    post_attn_norm="model.layers.{i}.post_attention_layernorm.weight",
+    post_mlp_norm="model.layers.{i}.post_feedforward_layernorm.weight",
+)
+
+FAMILIES: dict[str, Family] = {
+    "llama": Family("llama", _llama),
+    "mistral": Family("mistral", _mistral),
+    "qwen2": Family("qwen2", _qwen2),
+    "qwen3": Family(
+        "qwen3",
+        _qwen3,
+        WeightScheme(
+            q_norm="model.layers.{i}.self_attn.q_norm.weight",
+            k_norm="model.layers.{i}.self_attn.k_norm.weight",
+        ),
+    ),
+    "phi3": Family(
+        "phi3",
+        _phi3,
+        WeightScheme(
+            qkv="model.layers.{i}.self_attn.qkv_proj.{p}",
+            q=None, k=None, v=None, gate=None, up=None,
+            gate_up="model.layers.{i}.mlp.gate_up_proj.{p}",
+        ),
+    ),
+    "gemma": Family("gemma", _gemma, _GEMMA_SCHEME),
+    "gemma2": Family("gemma2", _gemma2, _GEMMA2_SCHEME),
+}
+
+
+def get_family(model_type: str) -> Family:
+    if model_type not in FAMILIES:
+        raise ValueError(
+            f"model_type {model_type!r} not supported yet; "
+            f"available: {sorted(FAMILIES)}"
+        )
+    return FAMILIES[model_type]

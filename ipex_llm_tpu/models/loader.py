@@ -1,0 +1,104 @@
+"""HF checkpoint reading (safetensors / torch bins), streamed.
+
+Reference counterpart: the ``from_pretrained(low_cpu_mem_usage=True)`` +
+``ggml_convert_low_bit`` load path (SURVEY.md §3.1) which must instantiate a
+full torch model before conversion.  Here checkpoints are a *weight source*:
+tensors are read lazily per name from safetensors shards (mmap, no torch
+model object) and quantized immediately, so host memory stays ~one layer
+ahead (the ``low_memory_init`` equivalent, reference optimize.py:124).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Iterator
+
+import numpy as np
+
+
+class CheckpointReader:
+    """Lazy name->tensor access over a local HF model directory."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._shard_of: dict[str, str] = {}
+        self._torch_bins: list[str] = []
+        st_files = sorted(
+            f for f in os.listdir(path) if f.endswith(".safetensors")
+        )
+        index_file = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index_file):
+            with open(index_file) as f:
+                weight_map = json.load(f)["weight_map"]
+            for name, shard in weight_map.items():
+                self._shard_of[name] = shard
+        elif st_files:
+            from safetensors import safe_open
+
+            for shard in st_files:
+                with safe_open(os.path.join(path, shard), framework="np") as f:
+                    for name in f.keys():
+                        self._shard_of[name] = shard
+        else:
+            self._torch_bins = sorted(
+                f for f in os.listdir(path)
+                if f.endswith(".bin") and f.startswith("pytorch_model")
+            )
+            if not self._torch_bins:
+                raise FileNotFoundError(
+                    f"no safetensors or pytorch_model bins under {path}"
+                )
+            self._torch_state = None
+
+    @lru_cache(maxsize=8)
+    def _open(self, shard: str):
+        from safetensors import safe_open
+
+        return safe_open(os.path.join(self.path, shard), framework="np")
+
+    def _torch_tensors(self):
+        if self._torch_state is None:
+            import torch
+
+            state: dict[str, "torch.Tensor"] = {}
+            for b in self._torch_bins:
+                state.update(
+                    torch.load(
+                        os.path.join(self.path, b),
+                        map_location="cpu",
+                        weights_only=True,
+                    )
+                )
+            self._torch_state = state
+        return self._torch_state
+
+    def names(self) -> list[str]:
+        if self._shard_of:
+            return sorted(self._shard_of)
+        return sorted(self._torch_tensors())
+
+    def has(self, name: str) -> bool:
+        return name in self._shard_of or (
+            self._torch_bins and name in self._torch_tensors()
+        )
+
+    def get(self, name: str) -> np.ndarray:
+        """Read one tensor as numpy (low-precision floats upcast to fp32)."""
+        if self._shard_of:
+            t = self._open(self._shard_of[name]).get_tensor(name)
+            if t.dtype.kind == "V":  # raw bf16 bytes from older safetensors
+                t = (t.view(np.uint16).astype(np.uint32) << 16).view(np.float32)
+            elif t.dtype.kind == "f" and t.itemsize <= 2:
+                t = t.astype(np.float32)
+            elif str(t.dtype) == "bfloat16":  # ml_dtypes
+                t = t.astype(np.float32)
+            return t
+        t = self._torch_tensors()[name]
+        return t.float().numpy()
+
+
+def read_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        return json.load(f)

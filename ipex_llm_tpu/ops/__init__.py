@@ -1,0 +1,21 @@
+"""TPU op library: the xe_linear/xe_batch/xe_addons equivalent (SURVEY.md §2.3).
+
+Each hot op ships a Pallas TPU kernel plus a pure-jnp XLA reference that
+doubles as the CPU fallback and test oracle.
+"""
+
+from ipex_llm_tpu.ops.linear import linear, qmatmul, qmatmul_reference
+from ipex_llm_tpu.ops.norms import layer_norm, rms_norm
+from ipex_llm_tpu.ops.rope import RopeScaling, apply_rope, cos_sin
+from ipex_llm_tpu.ops.attention import sdpa, sdpa_reference
+from ipex_llm_tpu.ops.mlp import gated_act_mul, split_gate_up
+from ipex_llm_tpu.ops.sampling import SamplingParams, sample
+
+__all__ = [
+    "linear", "qmatmul", "qmatmul_reference",
+    "layer_norm", "rms_norm",
+    "RopeScaling", "apply_rope", "cos_sin",
+    "sdpa", "sdpa_reference",
+    "gated_act_mul", "split_gate_up",
+    "SamplingParams", "sample",
+]

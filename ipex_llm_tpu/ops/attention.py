@@ -1,0 +1,101 @@
+"""Scaled dot-product attention (unified SDPA).
+
+Reference counterpart: the single entry point
+``models/common.py:219-306 scaled_dot_product_attention`` dispatching to
+``xe_addons.sdp / sdp_causal / sdp_non_causal / sdp_fp8*`` (§2.3).  Here one
+jnp reference implementation covers causal/non-causal, GQA, sliding window,
+and Gemma-style logit softcapping; the Pallas flash kernel
+(ops/pallas/flash_attention.py) takes over on TPU for the long-sequence
+prefill path.  All masking is static-shape: the KV buffer has a fixed
+``S_max`` and validity is derived from integer lengths, which keeps every
+shape XLA-static (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(kv: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] (GQA head broadcast)."""
+    if n_rep == 1:
+        return kv
+    b, s, h, d = kv.shape
+    return jnp.broadcast_to(kv[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def sdpa_reference(
+    q: jnp.ndarray,          # [B, T, Hq, D]
+    k: jnp.ndarray,          # [B, S, Hkv, D]
+    v: jnp.ndarray,          # [B, S, Hkv, Dv]
+    *,
+    scale: float | None = None,
+    causal: bool = True,
+    q_positions: jnp.ndarray | None = None,  # [B, T] absolute slot positions
+    kv_len: jnp.ndarray | None = None,       # [B] valid cache length
+    kv_start: jnp.ndarray | None = None,     # [B] first valid slot (left pad)
+    window: int | None = None,               # sliding-window size (static)
+    window_on: jnp.ndarray | bool = True,    # traced per-layer window enable
+    softcap: float | None = None,            # gemma2 logit softcapping
+    bias: jnp.ndarray | None = None,         # additive mask/bias [B,1|Hq,T,S]
+) -> jnp.ndarray:
+    """Returns [B, T, Hq, Dv] in q.dtype; softmax in fp32."""
+    b, t, hq, d = q.shape
+    s = k.shape[1]
+    hkv = k.shape[2]
+    n_rep = hq // hkv
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    if scale is None:
+        scale = d ** -0.5
+
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if softcap is not None:
+        scores = jnp.tanh(scores / softcap) * softcap
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+
+    kv_pos = jnp.arange(s)[None, None, None, :]  # [1,1,1,S]
+    mask = jnp.ones((b, 1, t, s), dtype=bool)
+    if kv_len is not None:
+        mask &= kv_pos < kv_len[:, None, None, None]
+    if kv_start is not None:
+        mask &= kv_pos >= kv_start[:, None, None, None]
+    if causal:
+        if q_positions is None:
+            q_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+        qp = q_positions[:, None, :, None]  # [B,1,T,1]
+        mask &= kv_pos <= qp
+        if window is not None:
+            in_window = kv_pos > qp - window
+            mask &= in_window | jnp.logical_not(window_on)
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """Backend-dispatching SDPA; see ``sdpa_reference`` for semantics."""
+    from ipex_llm_tpu.ops import dispatch
+
+    if dispatch.use_pallas() and q.shape[1] >= 128 and kwargs.get("bias") is None:
+        try:
+            from ipex_llm_tpu.ops.pallas import flash_attention
+
+            return flash_attention.flash_sdpa(q, k, v, **kwargs)
+        except NotImplementedError:
+            pass
+    return sdpa_reference(q, k, v, **kwargs)

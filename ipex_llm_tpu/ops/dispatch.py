@@ -1,0 +1,32 @@
+"""Backend dispatch for the op library.
+
+Every hot op has two implementations:
+  - a Pallas TPU kernel (the ``xe_linear``/``xe_addons`` equivalent, §2.3), and
+  - a pure-jnp XLA reference (the reference's CPU-fallback pattern,
+    models/common.py:289-306), which doubles as the test oracle.
+
+Selection is per-process: Pallas on TPU backends, jnp elsewhere, overridable
+with IPEX_LLM_TPU_DISABLE_PALLAS=1 (mirrors the reference's env-flag style,
+SURVEY.md §5 config system).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+
+
+@lru_cache(maxsize=None)
+def use_pallas() -> bool:
+    if os.environ.get("IPEX_LLM_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def clear_cache() -> None:
+    use_pallas.cache_clear()

@@ -1,0 +1,63 @@
+"""Quantized linear (dequant-matmul).
+
+TPU-native replacement for ``LowBitLinear.forward`` and its native kernels
+``xe_linear.forward_new`` / ``xe_batch.batch_forward`` (reference:
+low_bit_linear.py:605-756, §2.3).  Instead of a C++ dispatch per call, the op
+is a jittable function over a ``QTensor``; on TPU the packed-int4 path runs a
+Pallas kernel that streams packed bytes from HBM and unpacks them in VMEM next
+to the MXU (see ops/pallas/qmatmul.py), every other format falls back to an
+XLA dequantize→matmul which the compiler fuses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.ops import dispatch
+from ipex_llm_tpu.quantize import core as qcore
+from ipex_llm_tpu.quantize.core import QTensor
+
+
+def qmatmul_reference(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """x [..., in] @ dequant(qt) [in, out] -> [..., out]; XLA fallback/oracle."""
+    w = qcore.dequantize(qt, dtype=compute_dtype)
+    return jnp.matmul(x.astype(compute_dtype), w, preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+
+
+def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Quantized matmul with backend dispatch.
+
+    The Pallas path currently covers the 4-bit packed formats (sym_int4 /
+    asym_int4 / nf4 / fp4) and sym_int8 — the formats the reference routes to
+    ``xe_linear``/``xe_batch`` — and is gated on TPU availability.
+    """
+    if dispatch.use_pallas() and qt.qtype in (
+        "sym_int4",
+        "asym_int4",
+        "nf4",
+        "fp4",
+        "sym_int8",
+    ):
+        from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
+
+        return pallas_qmatmul.qmatmul_pallas(x, qt, compute_dtype)
+    return qmatmul_reference(x, qt, compute_dtype)
+
+
+def linear(x: jnp.ndarray, w, bias: jnp.ndarray | None = None) -> jnp.ndarray:
+    """General linear over either a QTensor or a plain array weight.
+
+    Reference counterpart: models/common.py:309 ``linear_forward``.
+    """
+    if isinstance(w, QTensor):
+        y = qmatmul(x, w)
+    else:
+        y = jnp.matmul(
+            x.astype(w.dtype), w, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y

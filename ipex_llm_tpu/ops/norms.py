@@ -1,0 +1,40 @@
+"""RMSNorm / LayerNorm.
+
+Reference counterparts: ``xe_addons.rms_norm`` / ``xe_addons.layer_norm``
+called through models/common.py:184,205.  On TPU these are bandwidth-bound
+elementwise+reduce ops that XLA fuses into neighbours, so the jnp form *is*
+the fast path; a bespoke Pallas kernel buys nothing here (unlike SYCL where
+the reference needed a fused kernel to avoid eager-mode dispatch overhead).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6,
+             offset: float = 0.0) -> jnp.ndarray:
+    """RMSNorm in fp32 accumulation, cast back to x.dtype.
+
+    ``offset=1.0`` covers Gemma-style (1+w) norms without a weight rewrite.
+    """
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * (weight.astype(jnp.float32) + offset)).astype(dt)
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray | None,
+               bias: jnp.ndarray | None, eps: float = 1e-5) -> jnp.ndarray:
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dt)

@@ -1,0 +1,128 @@
+"""Rotary position embeddings.
+
+Reference counterparts: ``xe_addons.rotary_half_inplaced`` /
+``rotary_two_inplaced`` (+ ``*_with_cache_inplaced``) called from the
+per-model attention forwards (llama.py:154-166, models/common.py:354-367).
+TPU-first shape: sin/cos are computed once per step from integer positions and
+applied as pure elementwise math that XLA fuses into the surrounding QKV ops —
+no in-place mutation, no cache side table.
+
+Two layouts, matching HF conventions:
+  - "half"  (rotate_half, llama/mistral/qwen): pairs are (x[i], x[i+d/2])
+  - "two"   (interleaved, chatglm/gptj style): pairs are (x[2i], x[2i+1])
+
+Scaling variants (linear / dynamic NTK / llama3 / yarn / longrope) are handled
+upstream by ``RopeScaling.inv_freq`` so this module stays a pure applicator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+    """Frequency table builder covering HF rope_scaling configs."""
+
+    head_dim: int
+    base: float = 10000.0
+    kind: str = "default"  # default | linear | dynamic | llama3 | yarn | longrope
+    factor: float = 1.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+    partial_rotary_factor: float = 1.0
+    attention_factor: float | None = None
+    short_factor: tuple[float, ...] | None = None
+    long_factor: tuple[float, ...] | None = None
+
+    @property
+    def rotary_dim(self) -> int:
+        rd = int(self.head_dim * self.partial_rotary_factor)
+        return rd - (rd % 2)
+
+    def inv_freq(self, seq_len: int | None = None) -> np.ndarray:
+        rd = self.rotary_dim
+        inv = 1.0 / (self.base ** (np.arange(0, rd, 2, dtype=np.float64) / rd))
+        if self.kind == "linear":
+            inv = inv / self.factor
+        elif self.kind == "dynamic":
+            # NTK-aware: stretch base when seq_len exceeds the original window
+            sl = max(seq_len or 0, self.original_max_position)
+            if sl > self.original_max_position:
+                base = self.base * (
+                    (self.factor * sl / self.original_max_position) - (self.factor - 1)
+                ) ** (rd / (rd - 2))
+                inv = 1.0 / (base ** (np.arange(0, rd, 2, dtype=np.float64) / rd))
+        elif self.kind == "llama3":
+            low_wl = self.original_max_position / self.low_freq_factor
+            high_wl = self.original_max_position / self.high_freq_factor
+            wl = 2 * np.pi / inv
+            smooth = (self.original_max_position / wl - self.low_freq_factor) / (
+                self.high_freq_factor - self.low_freq_factor
+            )
+            scaled = np.where(
+                wl < high_wl,
+                inv,
+                np.where(wl > low_wl, inv / self.factor,
+                         (1 - smooth) * inv / self.factor + smooth * inv),
+            )
+            inv = scaled
+        elif self.kind in ("yarn", "longrope"):
+            if self.kind == "longrope" and self.short_factor and self.long_factor:
+                sl = seq_len or self.original_max_position
+                ext = np.array(
+                    self.long_factor if sl > self.original_max_position else self.short_factor
+                )
+                inv = inv / ext
+            else:  # yarn interpolation ramp
+                lo = max(np.floor(rd * np.log(self.original_max_position /
+                         (32 * 2 * np.pi)) / (2 * np.log(self.base))), 0)
+                hi = min(np.ceil(rd * np.log(self.original_max_position /
+                         (1 * 2 * np.pi)) / (2 * np.log(self.base))), rd - 1)
+                ramp = np.clip(
+                    (np.arange(rd // 2, dtype=np.float64) - lo) / max(hi - lo, 1e-3), 0, 1
+                )
+                inv = inv / self.factor * ramp + inv * (1 - ramp)
+        return inv.astype(np.float32)
+
+    def mscale(self, seq_len: int | None = None) -> float:
+        if self.attention_factor is not None:
+            return float(self.attention_factor)
+        if self.kind == "yarn" and self.factor > 1:
+            return float(0.1 * np.log(self.factor) + 1.0)
+        return 1.0
+
+
+def cos_sin(positions: jnp.ndarray, inv_freq: jnp.ndarray, mscale: float = 1.0):
+    """positions [..., T] int -> (cos, sin) each [..., T, rotary_dim/2] fp32."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles) * mscale, jnp.sin(angles) * mscale
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               layout: str = "half") -> jnp.ndarray:
+    """Rotate q or k.
+
+    x: [B, T, H, D]; cos/sin: [B, T, D/2] (or broadcastable); returns same
+    shape/dtype as x.  For partial-rotary models pass x pre-split.
+    """
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    if layout == "half":
+        d2 = x.shape[-1] // 2
+        x1, x2 = xf[..., :d2], xf[..., d2:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    elif layout == "two":
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    else:
+        raise ValueError(f"unknown rope layout {layout!r}")
+    return out.astype(dt)

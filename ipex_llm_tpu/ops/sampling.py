@@ -1,0 +1,80 @@
+"""Token sampling ops (greedy / temperature / top-k / top-p / penalties).
+
+Reference counterparts: HF's LogitsProcessor stack used by the patched
+generate loops, plus ``xe_addons.repetition_penalty_logits_process_inplaced``
+(§2.3).  Implemented as pure jnp so the whole sample step stays inside the
+jitted decode program — no host round-trip per token, unlike the reference's
+Python-driven sampling loop (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = off
+    top_p: float = 1.0      # 1.0 = off
+    repetition_penalty: float = 1.0
+    do_sample: bool = False
+
+
+def apply_repetition_penalty(
+    logits: jnp.ndarray, prev_tokens: jnp.ndarray, penalty: float
+) -> jnp.ndarray:
+    """CTRL-style repetition penalty over previously seen tokens.
+
+    logits [B, V]; prev_tokens [B, L] with -1 padding for unused slots.
+    """
+    if penalty == 1.0:
+        return logits
+    b, v = logits.shape
+    seen = jnp.zeros((b, v), dtype=bool)
+    valid = prev_tokens >= 0
+    idx = jnp.where(valid, prev_tokens, 0)
+    seen = seen.at[jnp.arange(b)[:, None], idx].set(valid)
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def _top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens until cumulative prob exceeds p (always keep the top-1)
+    cutoff_mask = cum - probs > p
+    cutoff = jnp.where(cutoff_mask, NEG_INF, sorted_logits).min(axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def sample(
+    logits: jnp.ndarray,           # [B, V]
+    key: jax.Array,
+    params: SamplingParams,
+    prev_tokens: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Returns next token ids [B] (int32). Jit-safe with static params."""
+    logits = logits.astype(jnp.float32)
+    if prev_tokens is not None and params.repetition_penalty != 1.0:
+        logits = apply_repetition_penalty(logits, prev_tokens, params.repetition_penalty)
+    if not params.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if params.temperature not in (0.0, 1.0):
+        logits = logits / params.temperature
+    if params.top_k > 0:
+        logits = _top_k_mask(logits, params.top_k)
+    if params.top_p < 1.0:
+        logits = _top_p_mask(logits, params.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

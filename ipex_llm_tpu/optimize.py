@@ -1,0 +1,72 @@
+"""Generic ``optimize_model`` API (reference: optimize.py:199).
+
+The reference mutates a loaded torch model in place (swapping every nn.Linear for
+LowBitLinear).  Here a loaded torch HF model is treated as a weight source:
+its state_dict streams through the same quantizing param builder used by
+``from_pretrained``, producing a ``TPUModelForCausalLM``.  The torch model is
+untouched (and can be freed by the caller).
+
+``low_memory_init``/``load_low_bit`` mirror the reference's meta-device
+reload pair (optimize.py:124,137); with JAX there is no meta device to
+emulate — weights are only ever materialized quantized — so
+``low_memory_init`` is a no-op context kept for script compatibility.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any
+
+import numpy as np
+
+
+def optimize_model(model: Any, low_bit: str = "sym_int4", **kwargs):
+    """Convert a loaded HF torch model (or passthrough an already-converted
+    TPU model) to a quantized TPU model.
+
+    kwargs accepted for reference parity: ``optimize_llm``, ``modules_to_not_convert``
+    (unsupported modules keep bf16), ``cpu_embedding``.
+    """
+    from ipex_llm_tpu.models.build import build_params
+    from ipex_llm_tpu.models.families import get_family
+    from ipex_llm_tpu.transformers.model import TPUModelForCausalLM
+
+    if isinstance(model, TPUModelForCausalLM):
+        return model
+
+    if not hasattr(model, "state_dict") or not hasattr(model, "config"):
+        raise TypeError(
+            "optimize_model expects an HF torch model or a TPUModelForCausalLM, "
+            f"got {type(model)}"
+        )
+    hf_config = model.config.to_dict()
+    family = get_family(hf_config.get("model_type", "llama"))
+    cfg = family.to_config(hf_config)
+    state = model.state_dict()
+
+    def get(name: str) -> np.ndarray:
+        return state[name].detach().to("cpu").float().numpy()
+
+    def has(name: str) -> bool:
+        return name in state
+
+    params = build_params(cfg, family.scheme, get, has, qtype=low_bit)
+    return TPUModelForCausalLM(cfg, params, hf_config, low_bit)
+
+
+def load_low_bit(model_or_path: Any, model_path: str | None = None):
+    """Reload a ``save_low_bit`` checkpoint (reference optimize.py:137).
+
+    Accepts either just the path, or (model, path) like the reference — the
+    model argument is ignored because no skeleton is needed here.
+    """
+    from ipex_llm_tpu.transformers.model import TPUModelForCausalLM
+
+    path = model_path if model_path is not None else model_or_path
+    return TPUModelForCausalLM.load_low_bit(path)
+
+
+@contextmanager
+def low_memory_init():
+    """Reference optimize.py:124 compatibility shim (see module docstring)."""
+    yield

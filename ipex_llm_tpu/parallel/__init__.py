@@ -1,0 +1,13 @@
+"""Mesh-based parallelism (TP/DP/PP/EP/CP) over ICI/DCN.
+
+Replaces the reference's entire distributed stack — DeepSpeed AutoTP +
+oneCCL allreduce (low_bit_linear.py:715-722), torch.distributed pipeline
+send/recv (pipeline_parallel.py:300-446), gloo/Ray backends (SURVEY.md §2.2)
+— with JAX SPMD: one ``jax.sharding.Mesh``, NamedSharding rules per weight,
+and XLA-inserted collectives over ICI.  No process groups, no comm library.
+"""
+
+from ipex_llm_tpu.parallel.mesh import MeshSpec, make_mesh
+from ipex_llm_tpu.parallel.shard import shard_params, param_shardings
+
+__all__ = ["MeshSpec", "make_mesh", "shard_params", "param_shardings"]

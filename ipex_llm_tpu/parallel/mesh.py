@@ -1,0 +1,57 @@
+"""Device mesh construction.
+
+The canonical mesh axes (SURVEY.md §7 phase 5): ``dp`` (data/batch), ``tp``
+(tensor), ``ep`` (expert), ``cp`` (context/sequence).  Pipeline stages are a
+second-level split handled in parallel/pipeline.py.  The reference needed
+oneCCL process groups per strategy (SURVEY.md §2.2); here one mesh covers all
+of them and XLA lowers collectives onto ICI within a slice / DCN across
+slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+AXES = ("dp", "tp", "ep", "cp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    tp: int = 1
+    ep: int = 1
+    cp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.ep * self.cp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {"dp": self.dp, "tp": self.tp, "ep": self.ep, "cp": self.cp}
+
+
+def make_mesh(spec: MeshSpec | None = None, devices=None, **axis_sizes) -> Mesh:
+    """Build a 4-axis mesh; unspecified axes default to size 1.
+
+    ``make_mesh(tp=8)`` on a v5e-8 gives a pure-TP mesh; ``make_mesh(dp=2,
+    tp=4)`` splits the same chips 2×4.  Axis order puts ``tp`` innermost so
+    tensor-parallel collectives ride the fastest ICI links.
+    """
+    if spec is None:
+        spec = MeshSpec(**{k: axis_sizes.get(k, 1) for k in AXES})
+    devices = devices if devices is not None else jax.devices()
+    if spec.size > len(devices):
+        raise ValueError(
+            f"mesh {spec} needs {spec.size} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[: spec.size]).reshape(spec.dp, spec.cp, spec.ep, spec.tp)
+    return Mesh(arr, ("dp", "cp", "ep", "tp"))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshSpec())

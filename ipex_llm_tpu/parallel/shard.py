@@ -1,0 +1,130 @@
+"""Tensor-parallel sharding rules for the decoder param pytree.
+
+Replaces DeepSpeed AutoTP (reference convert.py:217-228: recognize sharded
+``LinearAllreduce``, store ``mp_group``, allreduce in LowBitLinear.forward
+low_bit_linear.py:715-722).  Megatron-style layout expressed declaratively:
+
+- qkv / gate_up projections: column-parallel (shard ``out`` over ``tp``) —
+  attention heads and MLP inner dim split across chips;
+- o / down projections: row-parallel (shard ``in`` over ``tp``) — XLA inserts
+  the psum over ICI during sharding propagation, the AutoTP
+  ``inference_all_reduce`` equivalent, no explicit collective in model code;
+- embedding / lm_head: vocab-sharded;
+- norms, biases on the sharded dim, rope tables: replicated / follow out.
+
+The rules apply to ``QTensor`` weights as well: packed code planes and block
+scales carry the same named sharding (their block axes are sub-divisions of
+the logical in-axis), so quantized TP works exactly like bf16 TP.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ipex_llm_tpu.quantize.core import QTensor
+
+# dict key -> parallel style for layer weights
+_COL = {"qkv", "gate_up", "moe_gate_up", "q_a", "kv_a"}
+_ROW = {"o", "down", "moe_down"}
+_COL_BIAS = {"qkv_bias", "gate_up_bias"}
+
+
+def _divisible(n: int, parts: int) -> bool:
+    return parts > 0 and n % parts == 0
+
+
+def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool) -> P:
+    """Pick the PartitionSpec for a QTensor's data/scales planes.
+
+    All planes are laid out ``[(L,)? in_like, out]``; col-parallel shards the
+    last axis, row-parallel the in-like axis.  Falls back to replication when
+    the packed/block axis does not divide evenly.
+    """
+    lead = (None,) if stacked else ()
+    data_in = qt.data.shape[-2]
+    nb = qt.scales.shape[-2] if qt.scales is not None else data_in
+    if kind == "col" and _divisible(qt.out_features, tp):
+        return P(*lead, None, "tp")
+    if kind == "row" and _divisible(data_in, tp) and _divisible(nb, tp):
+        return P(*lead, "tp", None)
+    return P()
+
+
+def param_shardings(params: dict, mesh: Mesh) -> dict:
+    """Build a sharding pytree matching ``params`` (QTensor-aware)."""
+    tp = mesh.shape.get("tp", 1)
+
+    def ns(spec: P) -> NamedSharding:
+        return NamedSharding(mesh, spec)
+
+    def qt_sharding(qt: QTensor, kind: str, stacked: bool):
+        spec = _qtensor_spec(qt, kind, tp, stacked)
+        return QTensor(
+            data=ns(spec),
+            scales=None if qt.scales is None else ns(spec),
+            zeros=None if qt.zeros is None else ns(spec),
+            qtype=qt.qtype, shape=qt.shape, block_size=qt.block_size,
+        )
+
+    def layer_entry(key: str, v: Any):
+        stacked = True
+        if isinstance(v, QTensor):
+            if key in _COL:
+                return qt_sharding(v, "col", stacked)
+            if key in _ROW:
+                return qt_sharding(v, "row", stacked)
+            return qt_sharding(v, "rep", stacked)
+        if key in _COL_BIAS and _divisible(v.shape[-1], tp):
+            return ns(P(None, "tp"))
+        return ns(P())
+
+    out: dict[str, Any] = {}
+    for key, v in params.items():
+        if key == "layers":
+            out[key] = {k: layer_entry(k, sub) for k, sub in v.items()}
+        elif key == "embed" and _divisible(v.shape[0], tp):
+            out[key] = ns(P("tp", None))
+        elif key == "lm_head":
+            if isinstance(v, QTensor):
+                out[key] = qt_sharding(v, "col", stacked=False)
+            else:
+                out[key] = ns(P())
+        elif isinstance(v, (float, int)):
+            out[key] = None  # static scalar, not a device array
+        else:
+            out[key] = ns(P())
+    return out
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place the param pytree onto the mesh under the TP rules."""
+    sh = param_shardings(params, mesh)
+
+    def place(p, s):
+        if s is None or isinstance(p, (float, int)):
+            return p
+        return jax.device_put(p, s)
+
+    out = {}
+    for key, v in params.items():
+        if key == "layers":
+            out[key] = {k: place(sub, sh[key][k]) for k, sub in v.items()}
+        else:
+            out[key] = place(v, sh[key])
+    return out
+
+
+def cache_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+    """KV cache [L, B, S, Hkv, D]: batch over dp, heads over tp (when they
+    divide; GQA with fewer kv heads than tp replicates instead)."""
+    tp = mesh.shape.get("tp", 1)
+    head_axis = "tp" if _divisible(n_kv_heads, tp) else None
+    return NamedSharding(mesh, P(None, "dp", None, head_axis, None))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Token batches [B, T]: batch over dp."""
+    return NamedSharding(mesh, P("dp", None))

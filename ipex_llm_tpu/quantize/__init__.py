@@ -1,0 +1,26 @@
+"""Low-bit block quantization: formats, codecs, and the QTensor pytree.
+
+Reference counterparts: ipex_llm/ggml/quantize.py (qtype table),
+low_bit_linear.py FP4Params (quantize-on-move tensor) and the ggml C
+quantize/dequantize bindings (§2.3).
+"""
+
+from ipex_llm_tpu.quantize.qtypes import (
+    ggml_tensor_qtype,
+    QTypeInfo,
+    all_qtypes,
+    is_supported,
+    resolve,
+)
+from ipex_llm_tpu.quantize.core import QTensor, dequantize, quantize
+
+__all__ = [
+    "ggml_tensor_qtype",
+    "QTypeInfo",
+    "QTensor",
+    "all_qtypes",
+    "is_supported",
+    "resolve",
+    "quantize",
+    "dequantize",
+]

@@ -1,0 +1,305 @@
+"""Block-quantized tensor core.
+
+TPU-first replacement for the reference's ``FP4Params`` tensor subclass and the
+ggml quantize/dequantize C routines (reference: low_bit_linear.py:332-491,
+ggml/model/llama/llama_cpp.py:71-109).  Differences by design:
+
+- A ``QTensor`` is a registered JAX pytree (packed code planes + fp16 scales
+  as leaves; qtype/shape static) so it flows through ``jit``/``pjit``/
+  ``jax.sharding`` like any array — no custom device-move hooks, no
+  cpu↔device layout conversion step (ggml_q_format_convet_cpu2xpu has no
+  TPU equivalent because the layout is already kernel-native).
+- Quantization happens along the matmul **contraction axis** (axis 0 of the
+  logical ``[in_features, out_features]`` weight).  Scales have shape
+  ``[n_blocks, out]``; packed int4 nibble pairs sit along the contraction
+  axis.  A Pallas tile ``[block, 128 lanes]`` therefore unpacks with two
+  vector shifts and multiplies straight into the MXU.
+- All codecs are pure jnp and jittable; the same code runs on CPU for tests
+  and TPU for real loads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.quantize import numerics, qtypes
+
+SCALE_DTYPE = jnp.float16
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QTensor:
+    """A block-quantized 2-D weight ``[in_features, out_features]``.
+
+    data:   packed codes; layout depends on qtype (see codecs below)
+    scales: per-(block, out) scale, fp16
+    zeros:  per-(block, out) zero/min for asym formats, else None
+    qtype:  resolved qtype name (static)
+    shape:  logical (in_features, out_features) (static)
+    block_size: contraction-axis block size (static)
+    """
+
+    data: jnp.ndarray
+    scales: jnp.ndarray | None
+    zeros: jnp.ndarray | None
+    qtype: str
+    shape: tuple[int, int]
+    block_size: int
+
+    def tree_flatten(self):
+        return (self.data, self.scales, self.zeros), (self.qtype, self.shape, self.block_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scales, zeros = children
+        qtype, shape, block_size = aux
+        return cls(data, scales, zeros, qtype, shape, block_size)
+
+    @property
+    def in_features(self) -> int:
+        return self.shape[0]
+
+    @property
+    def out_features(self) -> int:
+        return self.shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        if self.scales is not None:
+            n += self.scales.size * self.scales.dtype.itemsize
+        if self.zeros is not None:
+            n += self.zeros.size * self.zeros.dtype.itemsize
+        return n
+
+    def __repr__(self) -> str:  # keep pytree prints short
+        return f"QTensor({self.qtype}, {self.shape}, bs={self.block_size})"
+
+
+# ---------------------------------------------------------------------------
+# packing helpers (contraction axis = axis 0 of each [bs, out] block)
+# ---------------------------------------------------------------------------
+
+
+def _pack_nibbles(codes: jnp.ndarray) -> jnp.ndarray:
+    """[in, out] uint8 codes in [0,16) -> [in//2, out] packed bytes."""
+    lo = codes[0::2]
+    hi = codes[1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def _unpack_nibbles(packed: jnp.ndarray) -> jnp.ndarray:
+    """[in//2, out] bytes -> [in, out] uint8 codes (interleave rows)."""
+    lo = packed & 0x0F
+    hi = packed >> 4
+    # rows 2i <- lo[i], 2i+1 <- hi[i]
+    stacked = jnp.stack([lo, hi], axis=1)  # [in//2, 2, out]
+    return stacked.reshape(packed.shape[0] * 2, packed.shape[1])
+
+
+def _to_blocks(w: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """[in, out] -> [n_blocks, bs, out]"""
+    n_in, n_out = w.shape
+    if n_in % bs:
+        raise ValueError(f"in_features {n_in} not divisible by block_size {bs}")
+    return w.reshape(n_in // bs, bs, n_out)
+
+
+def _from_blocks(b: jnp.ndarray) -> jnp.ndarray:
+    return b.reshape(b.shape[0] * b.shape[1], b.shape[2])
+
+
+# ---------------------------------------------------------------------------
+# codecs: each returns (data, scales, zeros) / reconstructs float
+# ---------------------------------------------------------------------------
+
+
+def _quant_int_sym(w, bs: int, bits: int):
+    """llama.cpp-style symmetric round-to-nearest: d = signed_absmax / -2^(b-1),
+    codes biased into [0, 2^b)."""
+    blocks = _to_blocks(w, bs)
+    qmax = 1 << (bits - 1)  # 8 / 16 / 128
+    # pick the signed value with max magnitude so the sign of d matches it
+    amax_idx = jnp.argmax(jnp.abs(blocks), axis=1, keepdims=True)
+    signed_max = jnp.take_along_axis(blocks, amax_idx, axis=1)  # [nb, 1, out]
+    d = signed_max / -qmax
+    inv_d = jnp.where(d == 0, 0.0, 1.0 / d)
+    q = jnp.clip(jnp.round(blocks * inv_d) + qmax, 0, 2 * qmax - 1)
+    codes = _from_blocks(q.astype(jnp.uint8))
+    scales = d[:, 0, :].astype(SCALE_DTYPE)
+    if bits == 4:
+        data = _pack_nibbles(codes)
+    else:  # 5 and 8 bit stored one code per byte (int8 natively, int5 padded)
+        data = codes
+    return data, scales, None
+
+
+def _dequant_int_sym(qt: QTensor, bits: int):
+    qmax = 1 << (bits - 1)
+    codes = _unpack_nibbles(qt.data) if bits == 4 else qt.data
+    blocks = _to_blocks(codes.astype(jnp.float32) - qmax, qt.block_size)
+    return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
+
+
+def _quant_int_asym(w, bs: int, bits: int):
+    """q4_1/q5_1 style: d = (max-min)/(2^b-1), m = min; x ≈ q*d + m."""
+    blocks = _to_blocks(w, bs)
+    mn = jnp.min(blocks, axis=1, keepdims=True)
+    mx = jnp.max(blocks, axis=1, keepdims=True)
+    levels = (1 << bits) - 1
+    d = (mx - mn) / levels
+    inv_d = jnp.where(d == 0, 0.0, 1.0 / d)
+    q = jnp.clip(jnp.round((blocks - mn) * inv_d), 0, levels)
+    codes = _from_blocks(q.astype(jnp.uint8))
+    scales = d[:, 0, :].astype(SCALE_DTYPE)
+    zeros = mn[:, 0, :].astype(SCALE_DTYPE)
+    data = _pack_nibbles(codes) if bits == 4 else codes
+    return data, scales, zeros
+
+
+def _dequant_int_asym(qt: QTensor, bits: int):
+    codes = _unpack_nibbles(qt.data) if bits == 4 else qt.data
+    blocks = _to_blocks(codes.astype(jnp.float32), qt.block_size)
+    return _from_blocks(
+        blocks * qt.scales[:, None, :].astype(jnp.float32)
+        + qt.zeros[:, None, :].astype(jnp.float32)
+    )
+
+
+def _codebook_table(qtype: str) -> np.ndarray:
+    return {
+        "nf4": numerics.NF4_TABLE,
+        "nf3": numerics.NF3_TABLE,
+        "fp4": numerics.FP4_TABLE,
+    }[qtype]
+
+
+def _quant_codebook(w, bs: int, qtype: str, bits: int):
+    blocks = _to_blocks(w, bs)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    d = jnp.where(amax == 0, 1.0, amax)
+    normalized = blocks / d
+    codes = numerics.codebook_encode(normalized, _codebook_table(qtype))
+    codes = _from_blocks(codes)
+    scales = d[:, 0, :].astype(SCALE_DTYPE)
+    data = _pack_nibbles(codes) if bits == 4 else codes
+    return data, scales, None
+
+
+def _dequant_codebook(qt: QTensor, qtype: str, bits: int):
+    codes = _unpack_nibbles(qt.data) if bits == 4 else qt.data
+    vals = numerics.codebook_decode(codes, _codebook_table(qtype))
+    blocks = _to_blocks(vals, qt.block_size)
+    return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
+
+
+def _quant_fp6(w, bs: int):
+    blocks = _to_blocks(w, bs)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    d = jnp.where(amax == 0, 1.0, amax / numerics.FP6_MAX)
+    codes = numerics.codebook_encode(
+        jnp.clip(blocks / d, -numerics.FP6_MAX, numerics.FP6_MAX)
+        / numerics.FP6_MAX,
+        numerics.FP6_TABLE / numerics.FP6_MAX,
+    )
+    scales = d[:, 0, :].astype(SCALE_DTYPE)
+    return _from_blocks(codes), scales, None
+
+
+def _dequant_fp6(qt: QTensor):
+    vals = numerics.codebook_decode(qt.data, numerics.FP6_TABLE)
+    blocks = _to_blocks(vals, qt.block_size)
+    return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
+
+
+def _quant_fp8(w, bs: int, variant: str):
+    blocks = _to_blocks(w, bs)
+    fmax = numerics.FP8_E4M3_MAX if variant == "e4m3" else numerics.FP8_E5M2_MAX
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    d = jnp.where(amax == 0, 1.0, amax / fmax)
+    codes = numerics.fp8_to_codes(blocks / d, variant)
+    scales = d[:, 0, :].astype(SCALE_DTYPE)
+    return _from_blocks(codes), scales, None
+
+
+def _dequant_fp8(qt: QTensor, variant: str):
+    vals = numerics.fp8_from_codes(qt.data, variant)
+    blocks = _to_blocks(vals, qt.block_size)
+    return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def _as_jnp_f32(w: Any) -> jnp.ndarray:
+    if hasattr(w, "detach"):  # torch tensor without importing torch
+        w = w.detach().cpu().float().numpy()
+    return jnp.asarray(np.asarray(w), dtype=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("qtype", "block_size"))
+def _quantize_jit(w: jnp.ndarray, qtype: str, block_size: int):
+    info = qtypes.resolve(qtype)
+    if info.kind == "int_sym":
+        return _quant_int_sym(w, block_size, int(info.bits))
+    if info.kind == "int_asym":
+        return _quant_int_asym(w, block_size, int(info.bits))
+    if info.kind == "codebook":
+        return _quant_codebook(w, block_size, info.name, int(info.bits))
+    if info.kind == "minifloat":
+        if info.name == "fp6":
+            return _quant_fp6(w, block_size)
+        return _quant_fp8(w, block_size, info.name.split("_")[-1])
+    raise ValueError(f"cannot block-quantize kind={info.kind} ({qtype})")
+
+
+def quantize(w: Any, qtype: str, block_size: int | None = None) -> QTensor:
+    """Quantize a 2-D ``[in_features, out_features]`` weight.
+
+    Reference counterpart: ``FP4Params.quantize`` → ``ggml_convert_qtype``
+    (low_bit_linear.py:370,106); here a pure-jnp jitted codec.
+    """
+    info = qtypes.resolve(qtype)
+    w = _as_jnp_f32(w)
+    if w.ndim != 2:
+        raise ValueError(f"expected 2-D weight, got shape {w.shape}")
+    if info.kind == "native":
+        dt = jnp.float16 if info.name == "fp16" else jnp.bfloat16
+        return QTensor(w.astype(dt), None, None, info.name, tuple(w.shape), 0)
+    bs = block_size or info.block_size
+    data, scales, zeros = _quantize_jit(w, info.name, bs)
+    return QTensor(data, scales, zeros, info.name, tuple(w.shape), bs)
+
+
+@partial(jax.jit, static_argnames=("dtype",))
+def dequantize(qt: QTensor, dtype=jnp.float32) -> jnp.ndarray:
+    """Reconstruct the float weight ``[in_features, out_features]``."""
+    info = qtypes.resolve(qt.qtype)
+    if info.kind == "native":
+        return qt.data.astype(dtype)
+    if info.kind == "int_sym":
+        out = _dequant_int_sym(qt, int(info.bits))
+    elif info.kind == "int_asym":
+        out = _dequant_int_asym(qt, int(info.bits))
+    elif info.kind == "codebook":
+        out = _dequant_codebook(qt, info.name, int(info.bits))
+    elif info.kind == "minifloat":
+        out = _dequant_fp6(qt) if info.name == "fp6" else _dequant_fp8(
+            qt, info.name.split("_")[-1]
+        )
+    elif info.kind == "kquant":
+        from ipex_llm_tpu.quantize import kquants
+
+        out = kquants.dequantize(qt)
+    else:
+        raise ValueError(f"cannot dequantize {qt.qtype}")
+    return out.astype(dtype)

@@ -1,0 +1,205 @@
+"""GGUF k-quant superblock decoders (q2_k/q3_k/q4_k/q5_k/q6_k/q8_k).
+
+The reference imports GGUF k-quant tensors either by dequantizing them or by
+re-using the raw blocks in its native kernels (reference:
+transformers/gguf/api.py:31 and §2.1 "GGUF import").  Here the raw superblock
+bytes are kept verbatim in ``QTensor.data`` (shape ``[out, nb*type_size]``
+uint8) and decoded **in pure jnp** — shifts, masks and table-free arithmetic —
+so the decode can run fused on TPU inside the dequant-matmul path, not just on
+the host at load time.
+
+Implemented from the public GGUF/llama.cpp block-format *specification*
+(superblock structs of 256 elements with 6-bit sub-scales); this is an
+independent vectorized implementation, validated against a literal scalar
+spec decoder in tests/test_kquants.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QK_K = 256
+
+#: bytes per 256-element superblock
+TYPE_SIZES = {
+    "q2_k": 2 + 2 + 16 + 64,          # d, dmin, scales[16], qs[64] -> 84
+    "q3_k": 32 + 64 + 12 + 2,         # hmask[32], qs[64], scales[12], d -> 110
+    "q4_k": 2 + 2 + 12 + 128,         # d, dmin, scales[12], qs[128] -> 144
+    "q5_k": 2 + 2 + 12 + 32 + 128,    # d, dmin, scales[12], qh[32], qs[128] -> 176
+    "q6_k": 128 + 64 + 16 + 2,        # ql[128], qh[64], scales[16] int8, d -> 210
+    "q8_k": 4 + 256 + 32,             # d fp32, qs[256] int8, bsums[16] -> 292
+}
+
+
+def _f16(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """Two uint8 byte planes (little endian) -> float32 value."""
+    u16 = lo.astype(jnp.uint16) | (hi.astype(jnp.uint16) << 8)
+    return jax.lax.bitcast_convert_type(u16, jnp.float16).astype(jnp.float32)
+
+
+def _f32(b0, b1, b2, b3) -> jnp.ndarray:
+    u32 = (
+        b0.astype(jnp.uint32)
+        | (b1.astype(jnp.uint32) << 8)
+        | (b2.astype(jnp.uint32) << 16)
+        | (b3.astype(jnp.uint32) << 24)
+    )
+    return jax.lax.bitcast_convert_type(u32, jnp.float32)
+
+
+def _i8(b: jnp.ndarray) -> jnp.ndarray:
+    """uint8 byte plane -> signed int8 value as float32."""
+    return jnp.where(b >= 128, b.astype(jnp.int32) - 256, b.astype(jnp.int32)).astype(
+        jnp.float32
+    )
+
+
+def _scale_min_k4(scales: jnp.ndarray, j: int):
+    """6-bit (scale, min) pair j of 8 from the packed 12-byte q4_k/q5_k field."""
+    if j < 4:
+        sc = scales[..., j] & 63
+        m = scales[..., j + 4] & 63
+    else:
+        sc = (scales[..., j + 4] & 0x0F) | ((scales[..., j - 4] >> 6) << 4)
+        m = (scales[..., j + 4] >> 4) | ((scales[..., j] >> 6) << 4)
+    return sc.astype(jnp.float32), m.astype(jnp.float32)
+
+
+# Each decoder: raw [..., type_size] uint8 -> [..., 256] float32.
+
+
+def _dequant_q4_k(raw: jnp.ndarray) -> jnp.ndarray:
+    d = _f16(raw[..., 0], raw[..., 1])[..., None]
+    dmin = _f16(raw[..., 2], raw[..., 3])[..., None]
+    scales = raw[..., 4:16]
+    qs = raw[..., 16:144]  # [..., 128]
+    out = []
+    for j in range(8):  # sub-block j of 32 elements
+        grp = qs[..., (j // 2) * 32 : (j // 2) * 32 + 32]
+        q = (grp & 0x0F) if j % 2 == 0 else (grp >> 4)
+        sc, m = _scale_min_k4(scales, j)
+        out.append(d * sc[..., None] * q.astype(jnp.float32) - dmin * m[..., None])
+    return jnp.concatenate(out, axis=-1)
+
+
+def _dequant_q5_k(raw: jnp.ndarray) -> jnp.ndarray:
+    d = _f16(raw[..., 0], raw[..., 1])[..., None]
+    dmin = _f16(raw[..., 2], raw[..., 3])[..., None]
+    scales = raw[..., 4:16]
+    qh = raw[..., 16:48]   # [..., 32]
+    qs = raw[..., 48:176]  # [..., 128]
+    out = []
+    for j in range(8):
+        grp = qs[..., (j // 2) * 32 : (j // 2) * 32 + 32]
+        lo = (grp & 0x0F) if j % 2 == 0 else (grp >> 4)
+        hbit = (qh >> j) & 1
+        q = lo.astype(jnp.float32) + 16.0 * hbit.astype(jnp.float32)
+        sc, m = _scale_min_k4(scales, j)
+        out.append(d * sc[..., None] * q - dmin * m[..., None])
+    return jnp.concatenate(out, axis=-1)
+
+
+def _dequant_q6_k(raw: jnp.ndarray) -> jnp.ndarray:
+    ql = raw[..., 0:128]
+    qh = raw[..., 128:192]
+    sc = _i8(raw[..., 192:208])  # [..., 16] signed 8-bit sub-scales
+    d = _f16(raw[..., 208], raw[..., 209])[..., None]
+    halves = []
+    for n in range(2):  # two 128-element halves
+        lq = ql[..., n * 64 : n * 64 + 64]
+        hq = qh[..., n * 32 : n * 32 + 32]
+        s = sc[..., n * 8 : n * 8 + 8]
+        # four 32-element quarters within the half
+        q1 = (lq[..., 0:32] & 0x0F) | (((hq >> 0) & 3) << 4)
+        q2 = (lq[..., 32:64] & 0x0F) | (((hq >> 2) & 3) << 4)
+        q3 = (lq[..., 0:32] >> 4) | (((hq >> 4) & 3) << 4)
+        q4 = (lq[..., 32:64] >> 4) | (((hq >> 6) & 3) << 4)
+        quarters = [q1, q2, q3, q4]
+        vals = []
+        for qi, q in enumerate(quarters):
+            qf = q.astype(jnp.float32) - 32.0
+            # scale index: each quarter of 32 spans two 16-element scale groups
+            s0 = s[..., 2 * qi][..., None]
+            s1 = s[..., 2 * qi + 1][..., None]
+            vals.append(d * jnp.concatenate([s0 * qf[..., :16], s1 * qf[..., 16:]], axis=-1))
+        halves.append(jnp.concatenate(vals, axis=-1))
+    return jnp.concatenate(halves, axis=-1)
+
+
+def _dequant_q2_k(raw: jnp.ndarray) -> jnp.ndarray:
+    scales = raw[..., 0:16]
+    qs = raw[..., 16:80]
+    d = _f16(raw[..., 80], raw[..., 81])[..., None]
+    dmin = _f16(raw[..., 82], raw[..., 83])[..., None]
+    out = []
+    for n in range(2):  # 128-element groups, 32 source bytes each
+        grp = qs[..., n * 32 : n * 32 + 32]
+        for shift in (0, 2, 4, 6):
+            q = (grp >> shift) & 3
+            for half in range(2):  # two 16-element sub-blocks
+                idx = n * 8 + (shift // 2) * 2 + half
+                sc = (scales[..., idx] & 0x0F).astype(jnp.float32)[..., None]
+                m = (scales[..., idx] >> 4).astype(jnp.float32)[..., None]
+                qq = q[..., half * 16 : half * 16 + 16].astype(jnp.float32)
+                out.append(d * sc * qq - dmin * m)
+    return jnp.concatenate(out, axis=-1)
+
+
+def _q3_scales(scales: jnp.ndarray) -> list[jnp.ndarray]:
+    """Unpack 16 6-bit signed scales from the 12-byte q3_k field."""
+    out = []
+    for j in range(16):
+        low4 = (scales[..., j] & 0x0F) if j < 8 else (scales[..., j - 8] >> 4)
+        high2 = (scales[..., 8 + j % 4] >> (2 * (j // 4))) & 3
+        out.append((low4 | (high2 << 4)).astype(jnp.float32) - 32.0)
+    return out
+
+
+def _dequant_q3_k(raw: jnp.ndarray) -> jnp.ndarray:
+    hmask = raw[..., 0:32]
+    qs = raw[..., 32:96]
+    sc = _q3_scales(raw[..., 96:108])
+    d = _f16(raw[..., 108], raw[..., 109])[..., None]
+    out = []
+    for n in range(2):
+        grp = qs[..., n * 32 : n * 32 + 32]
+        for si, shift in enumerate((0, 2, 4, 6)):
+            mbit = n * 4 + si
+            q = ((grp >> shift) & 3).astype(jnp.int32)
+            h = ((hmask >> mbit) & 1).astype(jnp.int32)
+            q = (q - 4 * (1 - h)).astype(jnp.float32)
+            for half in range(2):
+                idx = n * 8 + si * 2 + half
+                out.append(d * sc[idx][..., None] * q[..., half * 16 : half * 16 + 16])
+    return jnp.concatenate(out, axis=-1)
+
+
+def _dequant_q8_k(raw: jnp.ndarray) -> jnp.ndarray:
+    d = _f32(raw[..., 0], raw[..., 1], raw[..., 2], raw[..., 3])[..., None]
+    return d * _i8(raw[..., 4:260])
+
+
+_DECODERS = {
+    "q2_k": _dequant_q2_k,
+    "q3_k": _dequant_q3_k,
+    "q4_k": _dequant_q4_k,
+    "q5_k": _dequant_q5_k,
+    "q6_k": _dequant_q6_k,
+    "q8_k": _dequant_q8_k,
+}
+
+
+def dequantize(qt) -> jnp.ndarray:
+    """QTensor with k-quant raw bytes -> float32 [in_features, out_features]."""
+    if qt.qtype not in _DECODERS:
+        raise NotImplementedError(
+            f"GGUF qtype {qt.qtype} decode not implemented yet "
+            f"(supported: {sorted(_DECODERS)})"
+        )
+    n_in, n_out = qt.shape
+    ts = TYPE_SIZES[qt.qtype]
+    nb = n_in // QK_K
+    raw = qt.data.reshape(n_out, nb, ts)
+    vals = _DECODERS[qt.qtype](raw)  # [out, nb, 256]
+    return vals.reshape(n_out, n_in).T

@@ -1,0 +1,127 @@
+"""Quantization type registry.
+
+Name/id parity with the reference's ``ggml_tensor_qtype`` table
+(reference: python/llm/src/ipex_llm/ggml/quantize.py:28-64) so user-facing
+``load_in_low_bit=...`` strings are drop-in compatible.  The *storage layouts*
+are our own TPU-first design (see ipex_llm_tpu/quantize/core.py): packed
+uint8 planes + fp16 block scales laid out along the matmul contraction axis so
+a Pallas kernel can unpack a (block, lane) tile with vector shifts and feed the
+MXU directly — not ggml's interleaved C blocks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class QTypeInfo:
+    """Static description of one quantization format.
+
+    kind:
+      int_sym    — signed ints, per-block absmax scale (q4_0/q5_0/q8_0 family)
+      int_asym   — unsigned ints, per-block scale + min (q4_1/q5_1 family)
+      codebook   — nearest-entry lookup table with absmax scale (nf4/nf3/fp4)
+      minifloat  — small float codes with per-block absmax scale (fp6/fp8)
+      native     — plain dtype cast, no blocks (fp16/bf16)
+      kquant     — GGUF k-quant superblocks (import/dequant path)
+      alias      — resolves to another qtype (rtn variants, fp8 synonyms)
+    """
+
+    name: str
+    qid: int
+    kind: str
+    bits: float = 0.0
+    block_size: int = 0
+    alias_of: str | None = None
+
+
+# name -> id table mirrors reference ggml/quantize.py:28-60 (names and ids only)
+_REGISTRY: dict[str, QTypeInfo] = {}
+
+
+def _reg(info: QTypeInfo) -> None:
+    _REGISTRY[info.name] = info
+
+
+_reg(QTypeInfo("sym_int4", 2, "int_sym", bits=4, block_size=32))
+_reg(QTypeInfo("asym_int4", 3, "int_asym", bits=4, block_size=32))
+_reg(QTypeInfo("sym_int5", 6, "int_sym", bits=5, block_size=32))
+_reg(QTypeInfo("asym_int5", 7, "int_asym", bits=5, block_size=32))
+_reg(QTypeInfo("sym_int8", 8, "int_sym", bits=8, block_size=32))
+_reg(QTypeInfo("nf4", 10, "codebook", bits=4, block_size=64))
+_reg(QTypeInfo("nf3", 11, "codebook", bits=3, block_size=64))
+_reg(QTypeInfo("fp16", 12, "native", bits=16))
+_reg(QTypeInfo("fp8_e4m3", 15, "minifloat", bits=8, block_size=128))
+_reg(QTypeInfo("fp4", 16, "codebook", bits=4, block_size=64))
+_reg(QTypeInfo("mixed_fp4", 17, "alias", alias_of="fp4"))   # MOFQ4: per-layer fp4/sym_int4 pick
+_reg(QTypeInfo("mixed_fp8", 18, "alias", alias_of="fp8_e4m3"))
+_reg(QTypeInfo("fp8_e5m2", 19, "minifloat", bits=8, block_size=128))
+_reg(QTypeInfo("fp8", 19, "alias", alias_of="fp8_e5m2"))
+_reg(QTypeInfo("bf16", 20, "native", bits=16))
+_reg(QTypeInfo("gguf_iq2_xxs", 21, "kquant", bits=2.0625, block_size=256))
+_reg(QTypeInfo("gguf_iq2_xs", 22, "kquant", bits=2.3125, block_size=256))
+_reg(QTypeInfo("q2_k", 23, "kquant", bits=2.5625, block_size=256))
+_reg(QTypeInfo("gguf_iq1_s", 24, "kquant", bits=1.5625, block_size=256))
+_reg(QTypeInfo("gguf_iq1_m", 25, "kquant", bits=1.75, block_size=256))
+_reg(QTypeInfo("q6_k", 26, "kquant", bits=6.5625, block_size=256))
+_reg(QTypeInfo("q4_k", 27, "kquant", bits=4.5, block_size=256))
+_reg(QTypeInfo("q5_k", 28, "kquant", bits=5.5, block_size=256))
+_reg(QTypeInfo("fp6", 29, "minifloat", bits=6, block_size=64))
+_reg(QTypeInfo("fp6_k", 30, "alias", alias_of="fp6"))
+_reg(QTypeInfo("sym_int4_rtn", 31, "alias", alias_of="sym_int4"))
+_reg(QTypeInfo("sym_int8_rtn", 32, "alias", alias_of="sym_int8"))
+_reg(QTypeInfo("asym_int4_rtn", 33, "alias", alias_of="asym_int4"))
+_reg(QTypeInfo("woq_int4", 34, "alias", alias_of="sym_int4"))
+_reg(QTypeInfo("torch_fp8_e5m2", 35, "alias", alias_of="fp8_e5m2"))
+_reg(QTypeInfo("torch_fp8", 35, "alias", alias_of="fp8_e5m2"))
+_reg(QTypeInfo("torch_fp8_e4m3", 36, "alias", alias_of="fp8_e4m3"))
+# q3_k / q8_k have no reference qtype id but are needed for GGUF import
+_reg(QTypeInfo("q3_k", 103, "kquant", bits=3.4375, block_size=256))
+_reg(QTypeInfo("q8_k", 108, "kquant", bits=8.5, block_size=256))
+
+#: name -> numeric id, the reference-compatible table
+ggml_tensor_qtype: dict[str, int] = {n: i.qid for n, i in _REGISTRY.items()}
+
+# gguf file-level tensor type ids (ggml GGMLQuantizationType) -> our qtype name;
+# used by the GGUF importer (reference counterpart: transformers/gguf/api.py)
+GGUF_TYPE_TO_QTYPE: dict[int, str] = {
+    0: "fp32",
+    1: "fp16",
+    2: "sym_int4",    # Q4_0
+    3: "asym_int4",   # Q4_1
+    6: "sym_int5",    # Q5_0
+    7: "asym_int5",   # Q5_1
+    8: "sym_int8",    # Q8_0
+    10: "q2_k",
+    11: "q3_k",
+    12: "q4_k",
+    13: "q5_k",
+    14: "q6_k",
+    15: "q8_k",
+    30: "bf16",
+}
+
+
+def resolve(qtype: str) -> QTypeInfo:
+    """Resolve a user-facing qtype name (following aliases) to its info."""
+    if qtype not in _REGISTRY:
+        raise ValueError(
+            f"Unknown load_in_low_bit qtype {qtype!r}. "
+            f"Supported: {sorted(_REGISTRY)}"
+        )
+    info = _REGISTRY[qtype]
+    seen = {qtype}
+    while info.kind == "alias":
+        assert info.alias_of is not None and info.alias_of not in seen
+        seen.add(info.alias_of)
+        info = _REGISTRY[info.alias_of]
+    return info
+
+
+def is_supported(qtype: str) -> bool:
+    return qtype in _REGISTRY
+
+
+def all_qtypes() -> list[str]:
+    return sorted(_REGISTRY)

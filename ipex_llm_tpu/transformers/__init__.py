@@ -1,0 +1,21 @@
+"""HF-compatible entry points (reference: ipex_llm/transformers/__init__.py).
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="sym_int4")
+"""
+
+from ipex_llm_tpu.transformers.model import (
+    AutoModel,
+    AutoModelForCausalLM,
+    AutoModelForSeq2SeqLM,
+    AutoModelForSpeechSeq2Seq,
+    TPUModelForCausalLM,
+)
+
+__all__ = [
+    "AutoModel",
+    "AutoModelForCausalLM",
+    "AutoModelForSeq2SeqLM",
+    "AutoModelForSpeechSeq2Seq",
+    "TPUModelForCausalLM",
+]

@@ -1,0 +1,111 @@
+"""End-to-end API tests against HF transformers on a tiny random checkpoint.
+
+Mirrors the reference's layer/logits-equivalence strategy
+(test/inference_gpu/test_transformers_api_final_logits.py, SURVEY.md §4):
+the optimized model's logits are compared elementwise to the HF torch model.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=199,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_llama"))
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def _hf_logits(model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        return model(torch.from_numpy(tokens).long()).logits.float().numpy()
+
+
+def test_bf16_logits_match_hf(tiny_llama):
+    path, hf_model = tiny_llama
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    tokens = np.random.default_rng(0).integers(0, 199, (2, 12)).astype(np.int32)
+    want = _hf_logits(hf_model, tokens)
+    got = np.asarray(model(tokens))
+    # bf16 compute vs fp32 torch: bounded elementwise error, same top-1
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.05
+    agree = (got.argmax(-1) == want.argmax(-1)).mean()
+    assert agree > 0.9, f"top-1 agreement {agree}"
+
+
+def test_sym_int4_generate_and_benchmark_attrs(tiny_llama):
+    path, _ = tiny_llama
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_4bit=True)
+    assert model.qtype == "sym_int4"
+    input_ids = torch.randint(0, 199, (1, 10))
+    out = model.generate(input_ids, max_new_tokens=8, do_sample=False)
+    assert isinstance(out, torch.Tensor)
+    assert out.shape[1] == 10 + 8
+    assert (out[:, :10] == input_ids).all()
+    assert model.first_cost is not None and model.rest_cost_mean is not None
+
+
+def test_generate_with_attention_mask(tiny_llama):
+    path, _ = tiny_llama
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    # HF-style left padding with mask
+    ids = np.array([[0, 0, 5, 6, 7], [1, 2, 3, 4, 5]], np.int64)
+    mask = np.array([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]], np.int64)
+    out = model.generate(
+        torch.from_numpy(ids), attention_mask=torch.from_numpy(mask),
+        max_new_tokens=4,
+    )
+    solo = model.generate(torch.tensor([[5, 6, 7]]), max_new_tokens=4)
+    np.testing.assert_array_equal(out[0, -4:].numpy(), solo[0, -4:].numpy())
+
+
+def test_save_load_low_bit_roundtrip(tiny_llama, tmp_path):
+    path, _ = tiny_llama
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="sym_int4")
+    save_dir = str(tmp_path / "low_bit")
+    model.save_low_bit(save_dir)
+    model2 = AutoModelForCausalLM.load_low_bit(save_dir)
+    assert model2.qtype == "sym_int4"
+    tokens = np.arange(8, dtype=np.int32)[None]
+    l1 = np.asarray(model(tokens))
+    l2 = np.asarray(model2(tokens))
+    np.testing.assert_allclose(l1, l2, atol=1e-5)
+
+
+def test_optimize_model_from_torch(tiny_llama):
+    path, hf_model = tiny_llama
+    from ipex_llm_tpu import optimize_model
+
+    model = optimize_model(hf_model, low_bit="sym_int8")
+    tokens = np.random.default_rng(1).integers(0, 199, (1, 9)).astype(np.int32)
+    want = _hf_logits(hf_model, tokens)
+    got = np.asarray(model(tokens))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.08

@@ -1,0 +1,108 @@
+"""Quantization codec tests.
+
+Mirrors the reference's numeric-equivalence test strategy (SURVEY.md §4:
+per-element max-abs-diff bounds) applied to quantize→dequantize roundtrips.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.quantize import QTensor, all_qtypes, dequantize, ggml_tensor_qtype, quantize, resolve
+
+RNG = np.random.default_rng(0)
+
+# max allowed rms reconstruction error (relative to weight rms) per format
+RMS_BOUNDS = {
+    "sym_int4": 0.12,
+    "asym_int4": 0.10,
+    "sym_int5": 0.06,
+    "asym_int5": 0.05,
+    "sym_int8": 0.005,
+    "nf4": 0.10,
+    "nf3": 0.22,
+    "fp4": 0.20,
+    "fp6": 0.06,
+    "fp8_e4m3": 0.06,
+    "fp8_e5m2": 0.12,
+}
+
+
+def _w(n_in=128, n_out=64):
+    return (RNG.standard_normal((n_in, n_out)) * 0.05).astype(np.float32)
+
+
+@pytest.mark.parametrize("qtype", sorted(RMS_BOUNDS))
+def test_roundtrip_error(qtype):
+    w = _w()
+    qt = quantize(w, qtype)
+    rec = np.asarray(dequantize(qt))
+    assert rec.shape == w.shape
+    rms = np.sqrt(np.mean((rec - w) ** 2)) / np.sqrt(np.mean(w**2))
+    assert rms < RMS_BOUNDS[qtype], f"{qtype}: rms rel err {rms}"
+
+
+@pytest.mark.parametrize("qtype", ["fp16", "bf16"])
+def test_native_passthrough(qtype):
+    w = _w()
+    qt = quantize(w, qtype)
+    rec = np.asarray(dequantize(qt))
+    np.testing.assert_allclose(rec, w, rtol=0.01, atol=1e-3)
+
+
+def test_aliases_resolve():
+    assert resolve("sym_int4_rtn").name == "sym_int4"
+    assert resolve("fp8").name == "fp8_e5m2"
+    assert resolve("torch_fp8_e4m3").name == "fp8_e4m3"
+    assert resolve("woq_int4").name == "sym_int4"
+    assert resolve("mixed_fp4").name == "fp4"
+
+
+def test_qtype_table_reference_parity():
+    # names and ids must match the reference table (ggml/quantize.py:28-60)
+    expected = {
+        "sym_int4": 2, "asym_int4": 3, "sym_int5": 6, "asym_int5": 7,
+        "sym_int8": 8, "nf4": 10, "nf3": 11, "fp16": 12, "fp8_e4m3": 15,
+        "fp4": 16, "mixed_fp4": 17, "mixed_fp8": 18, "fp8_e5m2": 19,
+        "fp8": 19, "bf16": 20, "q2_k": 23, "q6_k": 26, "q4_k": 27,
+        "q5_k": 28, "fp6": 29, "fp6_k": 30, "sym_int4_rtn": 31,
+        "sym_int8_rtn": 32, "asym_int4_rtn": 33, "woq_int4": 34,
+        "torch_fp8_e5m2": 35, "torch_fp8": 35, "torch_fp8_e4m3": 36,
+    }
+    for name, qid in expected.items():
+        assert ggml_tensor_qtype[name] == qid
+
+
+def test_int4_memory_footprint():
+    w = _w(256, 128)
+    qt = quantize(w, "sym_int4")
+    # 4 bits/weight + fp16 scale per 32-block: < 5 bits/weight total
+    assert qt.nbytes * 8 / w.size < 5.1
+
+
+def test_pytree_roundtrip():
+    import jax
+
+    qt = quantize(_w(), "sym_int4")
+    leaves, treedef = jax.tree_util.tree_flatten(qt)
+    qt2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(qt2, QTensor)
+    assert qt2.qtype == qt.qtype and qt2.shape == qt.shape
+    np.testing.assert_array_equal(np.asarray(qt2.data), np.asarray(qt.data))
+
+
+def test_jit_dequantize_traces_once():
+    import jax
+
+    qt = quantize(_w(), "nf4")
+    out1 = dequantize(qt)
+    out2 = dequantize(qt)  # cached trace
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_zero_block_stability():
+    w = np.zeros((64, 32), dtype=np.float32)
+    for qtype in ["sym_int4", "asym_int4", "nf4", "fp8_e4m3", "fp6"]:
+        rec = np.asarray(dequantize(quantize(w, qtype)))
+        assert np.all(np.isfinite(rec))
+        np.testing.assert_allclose(rec, 0.0, atol=1e-6)
