@@ -1,0 +1,5 @@
+"""Command-line front ends (reference: cli/llm-cli, cli/llm-chat).
+
+The reference's CLI picks a prebuilt native ``main-<family>`` binary; here
+both commands drive the one TPU generation engine directly.
+"""

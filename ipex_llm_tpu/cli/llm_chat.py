@@ -1,0 +1,59 @@
+"""``llm-chat``: interactive chat loop (reference: cli/llm-chat, portable-zip
+chat.py).  Uses the tokenizer's chat template when present, streams tokens as
+they decode."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv: list[str] | None = None) -> int:
+    from ipex_llm_tpu.cli.llm_cli import _load, _tokenizer
+
+    ap = argparse.ArgumentParser(prog="llm-chat")
+    ap.add_argument("-m", "--model", required=True)
+    ap.add_argument("-n", "--n-predict", type=int, default=256)
+    ap.add_argument("-x", "--low-bit", default="sym_int4")
+    args = ap.parse_args(argv)
+
+    tok = _tokenizer(args.model)
+    model = _load(args.model, args.low_bit)
+    history: list[dict] = []
+    print("llm-chat — empty line or Ctrl-D to exit")
+    while True:
+        try:
+            user = input("you> ").strip()
+        except EOFError:
+            break
+        if not user:
+            break
+        history.append({"role": "user", "content": user})
+        if tok.chat_template:
+            ids = tok.apply_chat_template(
+                history, add_generation_prompt=True, return_tensors="np"
+            )
+        else:
+            flat = "\n".join(m["content"] for m in history) + "\n"
+            ids = tok(flat, return_tensors="np").input_ids
+
+        pieces: list[str] = []
+
+        class _Streamer:
+            def put(self, row):
+                t = tok.decode(np.asarray(row).reshape(-1), skip_special_tokens=True)
+                pieces.append(t)
+                print(t, end="", flush=True)
+
+            def end(self):
+                print()
+
+        model.generate(ids, max_new_tokens=args.n_predict, streamer=_Streamer())
+        history.append({"role": "assistant", "content": "".join(pieces)})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

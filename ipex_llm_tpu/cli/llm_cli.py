@@ -1,0 +1,71 @@
+"""``llm-cli``: one-shot prompt completion from the terminal.
+
+Reference counterpart: cli/llm-cli:26-40, which execs a native
+``main-<family>`` binary with -m/-p/-n flags.  The flag names are kept so
+reference invocations work unchanged: ``llm-cli -m <model_dir> -p "..." -n 64``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _load(model_path: str, low_bit: str):
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    if os.path.exists(os.path.join(model_path, "bigdl_config.json")):
+        return AutoModelForCausalLM.load_low_bit(model_path)
+    return AutoModelForCausalLM.from_pretrained(model_path, load_in_low_bit=low_bit)
+
+
+def _tokenizer(model_path: str):
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(model_path, trust_remote_code=True)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="llm-cli", description="ipex-llm-tpu one-shot text generation"
+    )
+    ap.add_argument("-m", "--model", required=True, help="model directory")
+    ap.add_argument("-p", "--prompt", required=True)
+    ap.add_argument("-n", "--n-predict", type=int, default=128)
+    ap.add_argument("-x", "--low-bit", default="sym_int4",
+                    help="load_in_low_bit qtype (default sym_int4)")
+    ap.add_argument("-t", "--threads", type=int, default=0,
+                    help="accepted for reference-CLI parity; unused on TPU")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    tok = _tokenizer(args.model)
+    model = _load(args.model, args.low_bit)
+    ids = tok(args.prompt, return_tensors="np").input_ids
+    out = model.generate(
+        ids,
+        max_new_tokens=args.n_predict,
+        do_sample=args.temperature > 0,
+        temperature=args.temperature or 1.0,
+        top_p=args.top_p,
+        top_k=args.top_k,
+    )
+    text = tok.decode(out[0], skip_special_tokens=True)
+    print(text)
+    if model.first_cost is not None:
+        print(
+            f"[ttft {model.first_cost * 1e3:.1f} ms, "
+            f"decode {1.0 / max(model.rest_cost_mean, 1e-9):.1f} tok/s]",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

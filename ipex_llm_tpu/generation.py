@@ -170,9 +170,9 @@ def decode_loop(
         if eos is not None:
             done = done | (nxt[:, None] == eos[None, :]).any(axis=1)
         out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, step))
-        prev = jax.lax.dynamic_update_slice(
-            prev, nxt[:, None], (0, (lengths[0] + step) % REP_WINDOW)
-        )
+        # per-row ring write (rows have ragged lengths; a shared index would
+        # corrupt the ring for every row but the first)
+        prev = prev.at[jnp.arange(b), (lengths + step) % REP_WINDOW].set(nxt)
         return step + 1, nxt, cache, key, done, prev, out
 
     state = (jnp.asarray(1, jnp.int32), first_tokens, cache, key, done0,
@@ -187,12 +187,20 @@ def decode_loop(
 
 
 def _init_prev_ring(tokens: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-    """Seed the repetition-penalty ring with the prompt tail."""
+    """Seed the repetition-penalty ring with the prompt tail.
+
+    Slot convention: the token at absolute position ``p`` lives at ring index
+    ``p % REP_WINDOW`` — the same convention the decode loops use for writes,
+    so generation keeps evicting the *oldest* token even when the prompt is
+    longer than the window.
+    """
     b, tpad = tokens.shape
     ring = np.full((b, REP_WINDOW), -1, dtype=np.int32)
     for i in range(b):
-        tail = tokens[i, tpad - lengths[i]:][-REP_WINDOW:]
-        ring[i, : len(tail)] = tail
+        length = int(lengths[i])
+        row = tokens[i, tpad - length:]
+        for p in range(max(0, length - REP_WINDOW), length):
+            ring[i, p % REP_WINDOW] = row[p]
     return ring
 
 
@@ -215,11 +223,7 @@ def generate(
     capacity = tpad + _round_up(gen.max_new_tokens + 1, DECODE_BLOCK)
 
     if kv_kind == "auto":
-        kv_kind = (
-            "fp8"
-            if kv_mod.use_quantize_kv_cache(cfg.num_heads, cfg.num_kv_heads)
-            else "normal"
-        )
+        kv_kind = "fp8" if kv_mod.use_quantize_kv_cache() else "normal"
     cache = kv_mod.make_cache(
         kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
     )
@@ -236,6 +240,8 @@ def generate(
     )
     first.block_until_ready()
     ttft = time.perf_counter() - t0
+    # the first sampled token joins the penalty window immediately
+    prev_ring = prev_ring.at[jnp.arange(b), lengths_j % REP_WINDOW].set(first)
 
     kv_start = jnp.asarray((tpad - lengths).astype(np.int32))
     t1 = time.perf_counter()
@@ -273,7 +279,8 @@ def generate(
 
 
 @partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
-def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, key, gen: GenerationConfig):
+def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, ring_idx, key,
+                gen: GenerationConfig):
     logits, cache = decoder_forward(
         cfg, params, tok[:, None], cache, pos[:, None],
         kv_start=kv_start, last_token_only=True,
@@ -281,7 +288,8 @@ def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, key, gen: Generati
     key, sub = jax.random.split(key)
     sp = gen.sampling()
     nxt = sample(logits, sub, sp, prev if sp.repetition_penalty != 1.0 else None)
-    return nxt, cache, key
+    prev = prev.at[jnp.arange(nxt.shape[0]), ring_idx].set(nxt)
+    return nxt, cache, key, prev
 
 
 def _stream_decode(cfg, params, cache, first, lengths, kv_start, prev_ring,
@@ -296,8 +304,9 @@ def _stream_decode(cfg, params, cache, first, lengths, kv_start, prev_ring,
     step = 1
     while step < gen.max_new_tokens and not done.all():
         pos = lengths + step - 1
-        tok, cache, key = _decode_one(
-            cfg, params, cache, tok, pos, kv_start, prev_ring, key, gen
+        tok, cache, key, prev_ring = _decode_one(
+            cfg, params, cache, tok, pos, kv_start, prev_ring,
+            (lengths + step) % REP_WINDOW, key, gen,
         )
         row = np.asarray(tok)
         row = np.where(done, gen.pad_token_id, row)

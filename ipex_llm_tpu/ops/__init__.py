@@ -4,7 +4,12 @@ Each hot op ships a Pallas TPU kernel plus a pure-jnp XLA reference that
 doubles as the CPU fallback and test oracle.
 """
 
-from ipex_llm_tpu.ops.linear import linear, qmatmul, qmatmul_reference
+# NOTE: the `linear` *function* is deliberately exported as `linear_forward`;
+# re-exporting it under its own name would rebind the package attribute that
+# points at the `ops.linear` submodule and break `from ipex_llm_tpu.ops
+# import linear as linear_ops` module imports (round-1 regression).
+from ipex_llm_tpu.ops.linear import qmatmul, qmatmul_reference
+from ipex_llm_tpu.ops.linear import linear as linear_forward
 from ipex_llm_tpu.ops.norms import layer_norm, rms_norm
 from ipex_llm_tpu.ops.rope import RopeScaling, apply_rope, cos_sin
 from ipex_llm_tpu.ops.attention import sdpa, sdpa_reference
@@ -12,7 +17,7 @@ from ipex_llm_tpu.ops.mlp import gated_act_mul, split_gate_up
 from ipex_llm_tpu.ops.sampling import SamplingParams, sample
 
 __all__ = [
-    "linear", "qmatmul", "qmatmul_reference",
+    "linear_forward", "qmatmul", "qmatmul_reference",
     "layer_norm", "rms_norm",
     "RopeScaling", "apply_rope", "cos_sin",
     "sdpa", "sdpa_reference",

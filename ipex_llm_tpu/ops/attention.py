@@ -96,6 +96,6 @@ def sdpa(
             from ipex_llm_tpu.ops.pallas import flash_attention
 
             return flash_attention.flash_sdpa(q, k, v, **kwargs)
-        except NotImplementedError:
+        except (ImportError, NotImplementedError):
             pass
     return sdpa_reference(q, k, v, **kwargs)

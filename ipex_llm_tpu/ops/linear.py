@@ -41,9 +41,12 @@ def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndar
         "fp4",
         "sym_int8",
     ):
-        from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
+        try:
+            from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
 
-        return pallas_qmatmul.qmatmul_pallas(x, qt, compute_dtype)
+            return pallas_qmatmul.qmatmul_pallas(x, qt, compute_dtype)
+        except (ImportError, NotImplementedError):
+            pass  # fall through to the XLA reference path
     return qmatmul_reference(x, qt, compute_dtype)
 
 

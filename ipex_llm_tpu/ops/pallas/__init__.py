@@ -1,0 +1,17 @@
+"""Pallas TPU kernel library.
+
+The ``xe_linear`` / ``xe_batch`` / ``xe_addons`` equivalent (reference §2.3;
+call sites low_bit_linear.py:545,699, models/common.py:219-306): the hot ops
+where a hand-written kernel beats XLA's default lowering —
+
+- ``qmatmul``: fused block-dequant matmul.  Streams packed sub-byte codes
+  from HBM and unpacks them in VMEM next to the MXU, so INT4 decode moves
+  ~4x fewer HBM bytes than a bf16 matmul (the whole point of low-bit on a
+  bandwidth-bound decode).
+- ``flash_attention``: tiled online-softmax SDPA for long-sequence prefill;
+  never materializes the [T, S] score matrix in HBM.
+
+Every kernel has a pure-jnp reference twin in ``ipex_llm_tpu.ops`` used as
+the CPU fallback and the test oracle; kernels run in interpreter mode off-TPU
+so the same code paths are exercised by the CPU test suite.
+"""

@@ -55,7 +55,9 @@ def _top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     cum = jnp.cumsum(probs, axis=-1)
     # keep tokens until cumulative prob exceeds p (always keep the top-1)
     cutoff_mask = cum - probs > p
-    cutoff = jnp.where(cutoff_mask, NEG_INF, sorted_logits).min(axis=-1, keepdims=True)
+    # smallest *kept* logit: flood dropped slots with +inf before the min
+    # (NEG_INF here would make the cutoff -inf and mask nothing)
+    cutoff = jnp.where(cutoff_mask, jnp.inf, sorted_logits).min(axis=-1, keepdims=True)
     return jnp.where(logits < cutoff, NEG_INF, logits)
 
 

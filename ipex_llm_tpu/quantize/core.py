@@ -88,28 +88,42 @@ class QTensor:
 # ---------------------------------------------------------------------------
 
 
-def _pack_nibbles(codes: jnp.ndarray) -> jnp.ndarray:
-    """[in, out] uint8 codes in [0,16) -> [in//2, out] packed bytes."""
-    lo = codes[0::2]
-    hi = codes[1::2]
-    return (lo | (hi << 4)).astype(jnp.uint8)
+def _pack_nibbles(codes: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """[in, out] uint8 codes in [0,16) -> [in//2, out] packed bytes.
+
+    Block-local halves layout: within each ``bs``-row quantization block, row
+    ``j`` (low nibble) pairs with row ``j + bs/2`` (high nibble).  Unpacking a
+    block is then a contiguous [lo; hi] concat along the sublane axis — no
+    row interleave — which the Pallas dequant-matmul kernel
+    (ops/pallas/qmatmul.py) relies on for cheap in-VMEM unpack.
+    """
+    nb = codes.shape[0] // bs
+    c = codes.reshape(nb, bs, codes.shape[1])
+    lo, hi = c[:, : bs // 2], c[:, bs // 2 :]
+    return (lo | (hi << 4)).astype(jnp.uint8).reshape(-1, codes.shape[1])
 
 
-def _unpack_nibbles(packed: jnp.ndarray) -> jnp.ndarray:
-    """[in//2, out] bytes -> [in, out] uint8 codes (interleave rows)."""
-    lo = packed & 0x0F
-    hi = packed >> 4
-    # rows 2i <- lo[i], 2i+1 <- hi[i]
-    stacked = jnp.stack([lo, hi], axis=1)  # [in//2, 2, out]
-    return stacked.reshape(packed.shape[0] * 2, packed.shape[1])
+def _unpack_nibbles(packed: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """[in//2, out] bytes -> [in, out] uint8 codes (block-local halves)."""
+    nb = packed.shape[0] // (bs // 2)
+    p = packed.reshape(nb, bs // 2, packed.shape[1])
+    codes = jnp.concatenate([p & 0x0F, p >> 4], axis=1)
+    return codes.reshape(-1, packed.shape[1])
 
 
 def _to_blocks(w: jnp.ndarray, bs: int) -> jnp.ndarray:
-    """[in, out] -> [n_blocks, bs, out]"""
+    """[in, out] -> [n_blocks, bs, out], zero-padding a trailing partial block.
+
+    The reference's C quantizer requires whole blocks; models with
+    in_features not divisible by the block size (e.g. fp8's 128) get a
+    zero tail here, trimmed again by :func:`dequantize` (the VERDICT r1
+    "fp8 remainder" fix).
+    """
     n_in, n_out = w.shape
-    if n_in % bs:
-        raise ValueError(f"in_features {n_in} not divisible by block_size {bs}")
-    return w.reshape(n_in // bs, bs, n_out)
+    pad = (-n_in) % bs
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad, n_out), w.dtype)], axis=0)
+    return w.reshape(-1, bs, n_out)
 
 
 def _from_blocks(b: jnp.ndarray) -> jnp.ndarray:
@@ -135,7 +149,7 @@ def _quant_int_sym(w, bs: int, bits: int):
     codes = _from_blocks(q.astype(jnp.uint8))
     scales = d[:, 0, :].astype(SCALE_DTYPE)
     if bits == 4:
-        data = _pack_nibbles(codes)
+        data = _pack_nibbles(codes, bs)
     else:  # 5 and 8 bit stored one code per byte (int8 natively, int5 padded)
         data = codes
     return data, scales, None
@@ -143,7 +157,7 @@ def _quant_int_sym(w, bs: int, bits: int):
 
 def _dequant_int_sym(qt: QTensor, bits: int):
     qmax = 1 << (bits - 1)
-    codes = _unpack_nibbles(qt.data) if bits == 4 else qt.data
+    codes = _unpack_nibbles(qt.data, qt.block_size) if bits == 4 else qt.data
     blocks = _to_blocks(codes.astype(jnp.float32) - qmax, qt.block_size)
     return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
 
@@ -160,12 +174,12 @@ def _quant_int_asym(w, bs: int, bits: int):
     codes = _from_blocks(q.astype(jnp.uint8))
     scales = d[:, 0, :].astype(SCALE_DTYPE)
     zeros = mn[:, 0, :].astype(SCALE_DTYPE)
-    data = _pack_nibbles(codes) if bits == 4 else codes
+    data = _pack_nibbles(codes, bs) if bits == 4 else codes
     return data, scales, zeros
 
 
 def _dequant_int_asym(qt: QTensor, bits: int):
-    codes = _unpack_nibbles(qt.data) if bits == 4 else qt.data
+    codes = _unpack_nibbles(qt.data, qt.block_size) if bits == 4 else qt.data
     blocks = _to_blocks(codes.astype(jnp.float32), qt.block_size)
     return _from_blocks(
         blocks * qt.scales[:, None, :].astype(jnp.float32)
@@ -189,12 +203,12 @@ def _quant_codebook(w, bs: int, qtype: str, bits: int):
     codes = numerics.codebook_encode(normalized, _codebook_table(qtype))
     codes = _from_blocks(codes)
     scales = d[:, 0, :].astype(SCALE_DTYPE)
-    data = _pack_nibbles(codes) if bits == 4 else codes
+    data = _pack_nibbles(codes, bs) if bits == 4 else codes
     return data, scales, None
 
 
 def _dequant_codebook(qt: QTensor, qtype: str, bits: int):
-    codes = _unpack_nibbles(qt.data) if bits == 4 else qt.data
+    codes = _unpack_nibbles(qt.data, qt.block_size) if bits == 4 else qt.data
     vals = numerics.codebook_decode(codes, _codebook_table(qtype))
     blocks = _to_blocks(vals, qt.block_size)
     return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
@@ -302,4 +316,4 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jnp.ndarray:
         out = kquants.dequantize(qt)
     else:
         raise ValueError(f"cannot dequantize {qt.qtype}")
-    return out.astype(dtype)
+    return out[: qt.in_features].astype(dtype)

@@ -211,13 +211,33 @@ def _to_numpy(x):
     return x
 
 
-class _CausalAlias(TPUModelForCausalLM):
+class _NotYetSupported:
+    """Loader stub for reference Auto* classes whose decoders haven't landed.
+
+    The reference exposes 10 Auto* classes (model.py:791-827).  Aliasing the
+    seq2seq/vision ones to the causal LM would silently mis-load whisper-class
+    checkpoints, so they fail loudly instead.
+    """
+
+    _kind = "this model class"
+
+    @classmethod
+    def from_pretrained(cls, *args, **kwargs):
+        raise NotImplementedError(
+            f"{cls.__name__} is not supported yet by ipex_llm_tpu; "
+            "only decoder-only causal LMs load today"
+        )
+
+    load_low_bit = from_pretrained
+
+
+class AutoModelForSpeechSeq2Seq(_NotYetSupported):
     pass
 
 
-# The reference exposes 10 Auto* classes (model.py:791-827); seq2seq/vision
-# families route to the same loader until their decoders land.
+class AutoModelForSeq2SeqLM(_NotYetSupported):
+    pass
+
+
 AutoModelForCausalLM = TPUModelForCausalLM
 AutoModel = TPUModelForCausalLM
-AutoModelForSpeechSeq2Seq = _CausalAlias
-AutoModelForSeq2SeqLM = _CausalAlias

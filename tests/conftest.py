@@ -4,9 +4,11 @@ The reference has no unit-level multi-device testing (SURVEY.md §4); we improve
 on that by running every test — including sharded ones — on 8 virtual CPU
 devices, so TP/PP/CP paths are exercised without TPU hardware.
 
-Overrides (not setdefault): the environment may export JAX_PLATFORMS=axon to
-route jax at the real TPU tunnel; unit tests must stay on host CPU — the
-benchmark (bench.py) is what exercises the chip.
+NOTE: setting the JAX_PLATFORMS env var is NOT enough in this image — the
+axon TPU plugin's sitecustomize calls ``jax.config.update("jax_platforms",
+"axon,cpu")`` at interpreter start, which outranks the env var and routes
+``jax.devices()`` at the (slow) TPU tunnel.  Tests must override through the
+same config API.  The benchmark (bench.py) is what exercises the real chip.
 """
 
 import os
@@ -15,3 +17,7 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,103 @@
+"""Pallas kernel tests (interpreter mode on CPU).
+
+The jnp reference ops are the oracle (the reference's CPU-fallback testing
+pattern, SURVEY.md §4); the kernels must match them elementwise within
+bf16-accumulation tolerance.  On real TPU the same wrappers compile through
+Mosaic; here they run interpreted so CI exercises identical code paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.ops.linear import qmatmul_reference
+from ipex_llm_tpu.ops.pallas.flash_attention import flash_sdpa
+from ipex_llm_tpu.ops.pallas.qmatmul import qmatmul_pallas
+from ipex_llm_tpu.quantize import quantize
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.mark.parametrize("qtype", ["sym_int4", "asym_int4", "sym_int8", "nf4", "fp4"])
+def test_qmatmul_pallas_matches_reference(qtype):
+    k, n, m = 160, 200, 3
+    w = (RNG.standard_normal((k, n)) * 0.05).astype(np.float32)
+    x = (RNG.standard_normal((m, k)) * 0.5).astype(np.float32)
+    qt = quantize(w, qtype)
+    want = np.asarray(qmatmul_reference(jnp.asarray(x), qt))
+    got = np.asarray(qmatmul_pallas(jnp.asarray(x), qt))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_qmatmul_pallas_batched_input():
+    k, n = 64, 128
+    w = (RNG.standard_normal((k, n)) * 0.05).astype(np.float32)
+    x = RNG.standard_normal((2, 5, k)).astype(np.float32)
+    qt = quantize(w, "sym_int4")
+    want = np.asarray(qmatmul_reference(jnp.asarray(x), qt))
+    got = np.asarray(qmatmul_pallas(jnp.asarray(x), qt))
+    assert got.shape == (2, 5, n)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_qmatmul_pallas_bf16_activations():
+    k, n = 128, 256
+    w = (RNG.standard_normal((k, n)) * 0.05).astype(np.float32)
+    x = (RNG.standard_normal((4, k))).astype(jnp.bfloat16)
+    qt = quantize(w, "sym_int8")
+    want = np.asarray(qmatmul_reference(jnp.asarray(x), qt)).astype(np.float32)
+    got = np.asarray(qmatmul_pallas(jnp.asarray(x), qt)).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+def _attn_case(b=2, t=32, s=96, hq=4, hkv=2, d=64):
+    q = (RNG.standard_normal((b, t, hq, d)) * 0.3).astype(np.float32)
+    k = (RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32)
+    v = (RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+def test_flash_causal_gqa_matches_reference():
+    q, k, v = _attn_case()
+    b, t = q.shape[:2]
+    s = k.shape[1]
+    # decode-style: prompt occupies slots [kv_start, kv_len); queries at the end
+    kv_start = jnp.asarray([0, 8], jnp.int32)
+    kv_len = jnp.full((b,), s - 16, jnp.int32)
+    qpos = jnp.broadcast_to(jnp.arange(t)[None] + (s - 16 - t), (b, t))
+    kwargs = dict(
+        causal=True, q_positions=qpos, kv_len=kv_len, kv_start=kv_start
+    )
+    want = np.asarray(sdpa_reference(q, k, v, **kwargs))
+    got = np.asarray(flash_sdpa(q, k, v, **kwargs))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_flash_sliding_window_traced_flag():
+    q, k, v = _attn_case(b=1, t=48, s=48, hq=2, hkv=2, d=32)
+    qpos = jnp.broadcast_to(jnp.arange(48)[None], (1, 48))
+    base = dict(causal=True, q_positions=qpos,
+                kv_len=jnp.full((1,), 48, jnp.int32),
+                kv_start=jnp.zeros((1,), jnp.int32), window=16)
+    for flag in (True, False):
+        won = jnp.asarray(flag)
+        want = np.asarray(sdpa_reference(q, k, v, window_on=won, **base))
+        got = np.asarray(flash_sdpa(q, k, v, window_on=won, **base))
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2, err_msg=f"window_on={flag}")
+
+
+def test_flash_softcap():
+    q, k, v = _attn_case(b=1, t=16, s=16, hq=2, hkv=1, d=32)
+    want = np.asarray(sdpa_reference(q, k, v, softcap=30.0))
+    got = np.asarray(flash_sdpa(q, k, v, softcap=30.0))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_flash_bf16_long_prefill():
+    q, k, v = _attn_case(b=1, t=256, s=256, hq=4, hkv=1, d=64)
+    q, k, v = q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+    want = np.asarray(sdpa_reference(q, k, v)).astype(np.float32)
+    got = np.asarray(flash_sdpa(q, k, v)).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
