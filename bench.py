@@ -1,0 +1,131 @@
+"""Single-chip TPU benchmark — the all-in-one runner equivalent.
+
+Protocol follows the reference's perf harness (reference
+dev/benchmark/all-in-one/config.yaml:12-15, run.py:145): batch 1, sym_int4,
+1024 tokens in / 128 out, reporting decode tok/s and TTFT.  Model is a
+Llama-2-7B-shaped random checkpoint (hidden 4096 / ffn 11008 / 32 layers)
+built through the real quantize-on-load path — weights are synthesized
+per-tensor so the benchmark is hermetic (no checkpoint download exists in
+this environment) while exercising exactly the shapes of the reference's
+headline single-GPU model class.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tok_s, "unit": "tok/s", "vs_baseline": ...}
+
+Baseline: BASELINE.md north-star = 20 decode tok/s/chip (Llama-3-70B INT4 on
+v5e-16, i.e. per-chip parity target for the TP serving config).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _build_model(size: str, qtype: str):
+    import jax
+
+    from ipex_llm_tpu.models.random_init import llama_config, random_params
+
+    if size == "7b":
+        cfg = llama_config(
+            hidden_size=4096, intermediate_size=11008, num_layers=32,
+            num_heads=32, num_kv_heads=32, vocab_size=32000,
+            max_position_embeddings=4096,
+        )
+    elif size == "1b":
+        cfg = llama_config(
+            hidden_size=2048, intermediate_size=5632, num_layers=22,
+            num_heads=32, num_kv_heads=4, vocab_size=32000,
+            max_position_embeddings=4096,
+        )
+    else:  # tiny smoke config for CPU runs
+        cfg = llama_config(
+            hidden_size=256, intermediate_size=1024, num_layers=4,
+            num_heads=8, num_kv_heads=4, vocab_size=1024,
+        )
+
+    # quantize on the host CPU so only the packed planes cross the tunnel to
+    # the chip (~4.5 bit/weight instead of 32)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = random_params(cfg, qtype=qtype)
+
+    tpu_devices = [d for d in jax.devices() if d.platform != "cpu"]
+    if tpu_devices:
+        params = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, tpu_devices[0])
+            if hasattr(x, "shape") else x,
+            params,
+        )
+    return cfg, params
+
+
+def run(size: str, qtype: str, n_in: int, n_out: int, batch: int):
+    import numpy as np
+
+    from ipex_llm_tpu.generation import GenerationConfig, generate
+
+    t0 = time.perf_counter()
+    cfg, params = _build_model(size, qtype)
+    build_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, cfg.vocab_size, (batch, n_in)).astype(np.int32)
+    gen = GenerationConfig(max_new_tokens=n_out, do_sample=False)
+
+    # warmup: compile prefill + decode-loop programs
+    t0 = time.perf_counter()
+    res = generate(cfg, params, prompts, gen)
+    compile_s = time.perf_counter() - t0
+    # measured run
+    res = generate(cfg, params, prompts, gen)
+
+    decode_tok_s = batch / res.rest_token_s if res.rest_token_s > 0 else 0.0
+    return {
+        "cfg": cfg,
+        "build_s": build_s,
+        "compile_s": compile_s,
+        "ttft_s": res.first_token_s,
+        "decode_tok_s": decode_tok_s,
+    }
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    size = os.environ.get("BENCH_SIZE", "7b" if on_tpu else "tiny")
+    qtype = os.environ.get("BENCH_QTYPE", "sym_int4")
+    n_in = int(os.environ.get("BENCH_IN", "1024"))
+    n_out = int(os.environ.get("BENCH_OUT", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "1"))
+
+    try:
+        r = run(size, qtype, n_in, n_out, batch)
+    except Exception as e:  # Pallas path failed on this backend: XLA fallback
+        print(f"bench: retrying with Pallas disabled ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        os.environ["IPEX_LLM_TPU_DISABLE_PALLAS"] = "1"
+        from ipex_llm_tpu.ops import dispatch
+
+        dispatch.clear_cache()
+        r = run(size, qtype, n_in, n_out, batch)
+
+    baseline = 20.0  # BASELINE.md: >=20 decode tok/s/chip north-star
+    print(json.dumps({
+        "metric": f"llama_{size}_{qtype}_decode_tok_s_{n_in}in_{n_out}out_b{batch}",
+        "value": round(r["decode_tok_s"], 3),
+        "unit": "tok/s",
+        "vs_baseline": round(r["decode_tok_s"] / baseline, 3),
+        "ttft_s": round(r["ttft_s"], 4),
+        "compile_s": round(r["compile_s"], 1),
+        "backend": backend,
+    }))
+
+
+if __name__ == "__main__":
+    main()

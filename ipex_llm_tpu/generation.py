@@ -211,11 +211,18 @@ def generate(
     generation_config: GenerationConfig,
     kv_kind: str = "auto",
     streamer: Callable[[np.ndarray], None] | None = None,
+    mesh=None,
 ) -> GenerateResult:
     """End-to-end generate.  ``input_ids``: list of token lists or [B, T] array.
 
     When ``streamer`` is given, decode runs step-by-step from Python (one host
     sync per token) and the callback receives each new token row [B].
+
+    When ``mesh`` is given (a ``jax.sharding.Mesh``, params already placed by
+    ``parallel.shard.shard_params``), the KV cache and batch arrays are placed
+    with matching NamedShardings and the whole loop runs SPMD — XLA inserts
+    the TP psums over ICI (the AutoTP ``inference_all_reduce`` equivalent,
+    reference low_bit_linear.py:715-722) with no collective in model code.
     """
     gen = generation_config
     tokens, lengths, tpad = pad_batch(input_ids, gen.pad_token_id)
@@ -228,9 +235,30 @@ def generate(
         kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
     )
 
-    t0 = time.perf_counter()
+    spmd = mesh is not None and mesh.size > 1
+    from ipex_llm_tpu.ops import dispatch as _dispatch
+
+    _dispatch.set_spmd(spmd)
+    try:
+        return _generate_inner(
+            cfg, params, gen, tokens, lengths, tpad, b, cache, mesh, streamer
+        )
+    finally:
+        _dispatch.set_spmd(False)
+
+
+def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
+                    streamer):
+    tokens_j = jnp.asarray(tokens)
     lengths_j = jnp.asarray(lengths)
-    logits, cache = prefill_step(cfg, params, cache, jnp.asarray(tokens), lengths_j)
+    if mesh is not None:
+        from ipex_llm_tpu.parallel import shard as shard_mod
+
+        cache = shard_mod.shard_cache(cache, mesh)
+        tokens_j, lengths_j = shard_mod.shard_batch(mesh, b, tokens_j, lengths_j)
+
+    t0 = time.perf_counter()
+    logits, cache = prefill_step(cfg, params, cache, tokens_j, lengths_j)
     key = jax.random.PRNGKey(gen.seed)
     key, sub = jax.random.split(key)
     prev_ring = jnp.asarray(_init_prev_ring(tokens, lengths))
@@ -244,6 +272,12 @@ def generate(
     prev_ring = prev_ring.at[jnp.arange(b), lengths_j % REP_WINDOW].set(first)
 
     kv_start = jnp.asarray((tpad - lengths).astype(np.int32))
+    if mesh is not None:
+        from ipex_llm_tpu.parallel import shard as shard_mod
+
+        kv_start, prev_ring, first = shard_mod.shard_batch(
+            mesh, b, kv_start, prev_ring, first
+        )
     t1 = time.perf_counter()
     if streamer is None:
         out, steps, cache = decode_loop(

@@ -1,0 +1,89 @@
+"""Random model initialization through the real build path.
+
+Used by tests, the benchmark driver, and the multichip dry-run to fabricate a
+model of any size without a checkpoint on disk: random tensors are generated
+under the llama weight-naming scheme and fed through ``build_params`` exactly
+like a real safetensors read, so quantization/merging behave identically.
+Reference counterpart: the reference benchmarks on real checkpoints only
+(all-in-one/run.py); a synthetic path keeps CI hermetic.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from ipex_llm_tpu.models.build import build_params
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.families import FAMILIES
+from ipex_llm_tpu.ops.rope import RopeScaling
+
+
+def llama_config(
+    hidden_size: int = 64,
+    intermediate_size: int = 256,
+    num_layers: int = 2,
+    num_heads: int = 8,
+    num_kv_heads: int = 8,
+    head_dim: int | None = None,
+    vocab_size: int = 128,
+    max_position_embeddings: int = 2048,
+    **over,
+) -> ModelConfig:
+    hd = head_dim or hidden_size // num_heads
+    d = dict(
+        model_type="llama",
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        intermediate_size=intermediate_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        head_dim=hd,
+        max_position_embeddings=max_position_embeddings,
+        rope=RopeScaling(head_dim=hd),
+    )
+    d.update(over)
+    return ModelConfig(**d)
+
+
+def _llama_tensor_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
+    h, ffn, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    qd, kvd = cfg.q_dim, cfg.kv_dim
+    shapes: dict[str, tuple[int, ...]] = {}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        shapes[p + "input_layernorm.weight"] = (h,)
+        shapes[p + "post_attention_layernorm.weight"] = (h,)
+        shapes[p + "self_attn.q_proj.weight"] = (qd, h)
+        shapes[p + "self_attn.k_proj.weight"] = (kvd, h)
+        shapes[p + "self_attn.v_proj.weight"] = (kvd, h)
+        shapes[p + "self_attn.o_proj.weight"] = (h, qd)
+        shapes[p + "mlp.gate_proj.weight"] = (ffn, h)
+        shapes[p + "mlp.up_proj.weight"] = (ffn, h)
+        shapes[p + "mlp.down_proj.weight"] = (h, ffn)
+    shapes["model.embed_tokens.weight"] = (v, h)
+    shapes["model.norm.weight"] = (h,)
+    if not cfg.tie_word_embeddings:
+        shapes["lm_head.weight"] = (v, h)
+    return shapes
+
+
+def random_params(cfg: ModelConfig, qtype: str = "sym_int4", seed: int = 0) -> dict:
+    """Random llama-scheme params built through ``build_params`` (streamed:
+    each tensor is generated on demand, never the whole checkpoint at once)."""
+    shapes = _llama_tensor_shapes(cfg)
+    rng = np.random.default_rng(seed)
+
+    def gen(name: str) -> np.ndarray:
+        s = shapes[name]
+        if name.endswith("layernorm.weight") or name == "model.norm.weight":
+            return np.ones(s, np.float32) + 0.05 * rng.standard_normal(s).astype(
+                np.float32
+            )
+        scale = 0.3 / np.sqrt(max(s[-1], 1)) * 4
+        return (rng.standard_normal(s) * scale).astype(np.float32)
+
+    fam = FAMILIES["llama"]
+    return build_params(cfg, fam.scheme, gen, lambda n: n in shapes, qtype=qtype)

@@ -18,8 +18,26 @@ from functools import lru_cache
 import jax
 
 
-@lru_cache(maxsize=None)
+# When a >1-device mesh drives the model, the compute path must stay at the
+# XLA/GSPMD level: a bare ``pallas_call`` inside ``jit`` does not partition
+# under sharding propagation (it would need a shard_map wrapper).  The
+# generate/forward drivers flip this flag while tracing sharded programs.
+_spmd_active: bool = False
+
+
+def set_spmd(active: bool) -> None:
+    global _spmd_active
+    _spmd_active = bool(active)
+
+
 def use_pallas() -> bool:
+    if _spmd_active:
+        return False
+    return _use_pallas_env()
+
+
+@lru_cache(maxsize=None)
+def _use_pallas_env() -> bool:
     if os.environ.get("IPEX_LLM_TPU_DISABLE_PALLAS", "0") == "1":
         return False
     try:
@@ -29,4 +47,4 @@ def use_pallas() -> bool:
 
 
 def clear_cache() -> None:
-    use_pallas.cache_clear()
+    _use_pallas_env.cache_clear()

@@ -8,6 +8,16 @@ and XLA-inserted collectives over ICI.  No process groups, no comm library.
 """
 
 from ipex_llm_tpu.parallel.mesh import MeshSpec, make_mesh
-from ipex_llm_tpu.parallel.shard import shard_params, param_shardings
+from ipex_llm_tpu.parallel.shard import (
+    cache_sharding,
+    data_sharding,
+    param_shardings,
+    shard_batch,
+    shard_cache,
+    shard_params,
+)
 
-__all__ = ["MeshSpec", "make_mesh", "shard_params", "param_shardings"]
+__all__ = [
+    "MeshSpec", "make_mesh", "shard_params", "param_shardings",
+    "cache_sharding", "data_sharding", "shard_batch", "shard_cache",
+]

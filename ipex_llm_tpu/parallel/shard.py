@@ -117,14 +117,49 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
     return out
 
 
-def cache_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int = 0) -> NamedSharding:
     """KV cache [L, B, S, Hkv, D]: batch over dp, heads over tp (when they
     divide; GQA with fewer kv heads than tp replicates instead)."""
     tp = mesh.shape.get("tp", 1)
+    dp = mesh.shape.get("dp", 1)
     head_axis = "tp" if _divisible(n_kv_heads, tp) else None
-    return NamedSharding(mesh, P(None, "dp", None, head_axis, None))
+    batch_axis = "dp" if _divisible(batch, dp) else None
+    return NamedSharding(mesh, P(None, batch_axis, None, head_axis, None))
 
 
-def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Token batches [B, T]: batch over dp."""
-    return NamedSharding(mesh, P("dp", None))
+def data_sharding(mesh: Mesh, batch: int = 0) -> NamedSharding:
+    """Token batches [B, T]: batch over dp (replicated when non-divisible)."""
+    dp = mesh.shape.get("dp", 1)
+    axis = "dp" if _divisible(batch, dp) else None
+    return NamedSharding(mesh, P(axis, None))
+
+
+def shard_cache(cache, mesh: Mesh):
+    """Place a KVCache pytree onto the mesh (k/v sharded, length replicated)."""
+    n_kv_heads = cache.k.shape[3]
+    batch = cache.k.shape[1]
+    kv_sh = cache_sharding(mesh, n_kv_heads, batch)
+    rep = NamedSharding(mesh, P())
+    from dataclasses import replace as _replace
+
+    return _replace(
+        cache,
+        k=jax.device_put(cache.k, kv_sh),
+        v=jax.device_put(cache.v, kv_sh),
+        length=jax.device_put(cache.length, rep),
+    )
+
+
+def shard_batch(mesh: Mesh, batch: int, *arrays):
+    """Place per-sequence arrays (leading batch axis) onto the dp axis."""
+    dp = mesh.shape.get("dp", 1)
+    axis = "dp" if _divisible(batch, dp) else None
+
+    def place(a):
+        import jax.numpy as jnp
+
+        a = jnp.asarray(a)
+        spec = (axis,) + (None,) * (a.ndim - 1)
+        return jax.device_put(a, NamedSharding(mesh, P(*spec)))
+
+    return tuple(place(a) for a in arrays)

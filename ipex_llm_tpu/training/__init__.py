@@ -1,0 +1,15 @@
+"""Training: QLoRA / ReLoRA / LISA and the shared train-step builder.
+
+Reference counterparts: qlora.py (LoraLowBitLinear :66, get_peft_model :254),
+relora.py:64, lisa.py:23, plus the straight-through dequant backward of
+``MatMulLowBit`` (low_bit_linear.py:552-573).  TPU-native design: training is
+a pure jitted step function over a param pytree — no Trainer monkey-patching;
+parallelism comes from the same mesh shardings as inference.
+"""
+
+from ipex_llm_tpu.training.step import (
+    causal_lm_loss,
+    make_train_step,
+)
+
+__all__ = ["causal_lm_loss", "make_train_step"]

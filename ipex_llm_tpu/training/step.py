@@ -1,0 +1,62 @@
+"""Shared training step: causal-LM loss + optimizer update as ONE jitted fn.
+
+The reference delegates its training loop to HF Trainer + DeepSpeed and
+patches modules underneath (training_patch.py:68-223); here the whole step —
+forward, backward, optimizer — is a single XLA program.  Under a sharded
+param pytree (parallel/shard.py) the same program runs dp/tp/cp-parallel with
+XLA-inserted collectives: grads are psum'd over ``dp`` automatically because
+the loss averages over the batch axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.decoder import decoder_forward
+
+
+def causal_lm_loss(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,          # [B, T] int32
+    loss_mask: jnp.ndarray | None = None,  # [B, T-1] 1.0 where target counts
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over the batch (fp32 softmax)."""
+    b, t = tokens.shape
+    cache = KVCache.init(cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim)
+    pos = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    logits, _ = decoder_forward(cfg, params, tokens, cache, pos)
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        return (nll * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
+    return nll.mean()
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    optimizer: Any,
+    loss_fn: Callable | None = None,
+) -> Callable:
+    """Build a jitted ``step(params, opt_state, tokens) -> (params, opt_state,
+    loss)``.  ``optimizer`` is any optax GradientTransformation."""
+    import optax
+
+    loss_fn = loss_fn or causal_lm_loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=1)(cfg, params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

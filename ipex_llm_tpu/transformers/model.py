@@ -58,6 +58,7 @@ class TPUModelForCausalLM:
         self.hf_config = hf_config
         self.params = params
         self.qtype = qtype
+        self.mesh = None  # set by .shard(mesh) for SPMD inference
         # BenchmarkWrapper-compatible timing attributes
         self.first_cost: float | None = None
         self.rest_cost_mean: float | None = None
@@ -84,6 +85,7 @@ class TPUModelForCausalLM:
             )
         qtype = _resolve_qtype(kwargs)
         mixed_precision = kwargs.pop("mixed_precision", False)
+        mesh = kwargs.pop("mesh", None)
         kwargs.pop("optimize_model", True)
         kwargs.pop("torch_dtype", None)
         kwargs.pop("trust_remote_code", None)
@@ -96,7 +98,23 @@ class TPUModelForCausalLM:
             cfg, family.scheme, reader.get, reader.has,
             qtype=qtype, mixed_precision=mixed_precision,
         )
-        return cls(cfg, params, hf_config, qtype)
+        model = cls(cfg, params, hf_config, qtype)
+        if mesh is not None:
+            model.shard(mesh)
+        return model
+
+    def shard(self, mesh) -> "TPUModelForCausalLM":
+        """Place the params onto a ``jax.sharding.Mesh`` under the TP rules.
+
+        The AutoTP equivalent (reference convert.py:217-228 +
+        low_bit_linear.py:715-722): column/row-parallel NamedShardings per
+        projection; XLA inserts the psum over ICI during compilation.
+        """
+        from ipex_llm_tpu.parallel.shard import shard_params
+
+        self.params = shard_params(self.params, mesh)
+        self.mesh = mesh
+        return self
 
     @classmethod
     def load_low_bit(cls, path: str, *args, **kwargs):
@@ -130,9 +148,21 @@ class TPUModelForCausalLM:
             self.config.num_kv_heads, self.config.head_dim,
         )
         pos = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
-        logits, _ = decoder_forward(
-            self.config, self.params, jnp.asarray(tokens), cache, pos
-        )
+        tokens_j = jnp.asarray(tokens)
+        from ipex_llm_tpu.ops import dispatch as _dispatch
+
+        _dispatch.set_spmd(self.mesh is not None and self.mesh.size > 1)
+        try:
+            if self.mesh is not None:
+                from ipex_llm_tpu.parallel.shard import shard_batch, shard_cache
+
+                cache = shard_cache(cache, self.mesh)
+                (tokens_j,) = shard_batch(self.mesh, b, tokens_j)
+            logits, _ = decoder_forward(
+                self.config, self.params, tokens_j, cache, pos
+            )
+        finally:
+            _dispatch.set_spmd(False)
         return logits
 
     def generate(
@@ -172,7 +202,10 @@ class TPUModelForCausalLM:
             def stream_cb(row):  # HF TextStreamer protocol: put(token_ids)
                 streamer.put(np.asarray(row))
 
-        res = generate(self.config, self.params, rows, gcfg, streamer=stream_cb)
+        res = generate(
+            self.config, self.params, rows, gcfg, streamer=stream_cb,
+            mesh=self.mesh,
+        )
         if streamer is not None and hasattr(streamer, "end"):
             streamer.end()
         self.first_cost = res.first_token_s
