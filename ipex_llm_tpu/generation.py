@@ -63,6 +63,19 @@ class GenerationConfig:
             do_sample=self.do_sample,
         )
 
+    def with_kwargs(self, kwargs: dict) -> "GenerationConfig":
+        """Pop HF-style generate kwargs into a new config (int eos coerced)."""
+        from dataclasses import replace as _replace
+
+        fields = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in GenerationConfig.__dataclass_fields__
+        }
+        if isinstance(fields.get("eos_token_id"), int):
+            fields["eos_token_id"] = (fields["eos_token_id"],)
+        return _replace(self, **fields) if fields else self
+
 
 @dataclass
 class GenerateResult:
@@ -71,6 +84,10 @@ class GenerateResult:
     num_new_tokens: np.ndarray     # [B]
     first_token_s: float = 0.0     # TTFT (prefill + first sample)
     rest_token_s: float = 0.0      # mean per-token latency after the first
+    # speculative-decoding acceptance telemetry (reference clear_benchmarks)
+    n_rounds: int = 0
+    n_drafted: int = 0
+    n_matched: int = 0
 
 
 def _round_up(n: int, m: int) -> int:
@@ -235,16 +252,12 @@ def generate(
         kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
     )
 
-    spmd = mesh is not None and mesh.size > 1
     from ipex_llm_tpu.ops import dispatch as _dispatch
 
-    _dispatch.set_spmd(spmd)
-    try:
+    with _dispatch.spmd(mesh is not None and mesh.size > 1):
         return _generate_inner(
             cfg, params, gen, tokens, lengths, tpad, b, cache, mesh, streamer
         )
-    finally:
-        _dispatch.set_spmd(False)
 
 
 def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,

@@ -82,8 +82,8 @@ def random_params(cfg: ModelConfig, qtype: str = "sym_int4", seed: int = 0) -> d
             return np.ones(s, np.float32) + 0.05 * rng.standard_normal(s).astype(
                 np.float32
             )
-        scale = 0.3 / np.sqrt(max(s[-1], 1)) * 4
-        return (rng.standard_normal(s) * scale).astype(np.float32)
+        scale = np.float32(0.3 / np.sqrt(max(s[-1], 1)) * 4)
+        return rng.standard_normal(s, dtype=np.float32) * scale
 
     fam = FAMILIES["llama"]
     return build_params(cfg, fam.scheme, gen, lambda n: n in shapes, qtype=qtype)

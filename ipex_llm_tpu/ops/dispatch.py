@@ -30,6 +30,21 @@ def set_spmd(active: bool) -> None:
     _spmd_active = bool(active)
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def spmd(active: bool):
+    """Scoped SPMD flag that restores the previous value (nesting-safe)."""
+    global _spmd_active
+    prev = _spmd_active
+    _spmd_active = bool(active) or prev
+    try:
+        yield
+    finally:
+        _spmd_active = prev
+
+
 def use_pallas() -> bool:
     if _spmd_active:
         return False

@@ -55,11 +55,12 @@ def _codebook_select(codes: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
 def _dequant_tile(codes, scales, zeros, qtype: str, bs: int, bk: int, bn: int):
     """codes [BK(/2), BN] -> w [BK, BN] f32 inside the kernel."""
     nb = bk // bs
+    # Mosaic can't lower uint8 bit-ops/casts directly; widen to int32 first
     if qtype in ("sym_int4", "asym_int4", "nf4", "fp4"):
-        p = codes.reshape(nb, bs // 2, bn)
+        p = codes.reshape(nb, bs // 2, bn).astype(jnp.int32)
         c = jnp.concatenate([p & 0x0F, p >> 4], axis=1)  # [nb, bs, bn]
     else:  # sym_int8
-        c = codes.reshape(nb, bs, bn)
+        c = codes.reshape(nb, bs, bn).astype(jnp.int32)
     s = scales.reshape(nb, 1, bn)
     if qtype == "sym_int4":
         w = (c.astype(jnp.float32) - 8.0) * s

@@ -1,0 +1,315 @@
+"""Self-speculative + prompt-lookup decoding, fully jitted.
+
+Reference counterparts: ``speculative_generate`` (reference
+speculative.py:805-1100 — draft k tokens with the sym_int4 copy of the same
+weights, verify in ONE batched target forward, accept the longest matching
+prefix, crop the KV cache) and ``PromptLookupCandidateGenerator`` /
+``lookup_generate`` (lookup.py:145-274 — n-gram candidates mined from the
+sequence so far, no draft model at all).
+
+TPU-native redesign (one XLA program, zero host syncs per round):
+
+- the whole draft→verify→accept loop is a ``lax.while_loop``; every round
+  has a static shape (k draft steps, k+1 verify tokens);
+- **KV "crop" is free**: cache validity is governed by the ``length`` scalar
+  that masks attention (kv.py), so rolling back speculative entries is just
+  resetting ``length`` — no copies, unlike the reference's
+  ``_crop_past_key_values`` tensor surgery (speculative.py:480);
+- the draft cache is healed by an idempotent 2-token catch-up step each
+  round: re-writing a KV slot for an already-accepted token produces
+  identical values, so the draft cache never needs rollback bookkeeping;
+- prompt-lookup runs the same verify loop with the draft forward replaced by
+  a vectorized n-gram scan over the generated-so-far ring.
+
+Greedy only (the reference's benchmark path): with greedy verification the
+output is guaranteed token-identical to plain target-model decoding.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import replace
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu import kv as kv_mod
+from ipex_llm_tpu.generation import (
+    DECODE_BLOCK,
+    GenerateResult,
+    GenerationConfig,
+    _round_up,
+    pad_batch,
+)
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.decoder import decoder_forward
+
+
+def _greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
+def _forward_at(cfg, params, cache, seq_buf, start, t: int, length):
+    """Run ``t`` tokens seq_buf[start:start+t] with cache length set to
+    ``length``; returns (logits [1,t,V], cache advanced to length+t)."""
+    tokens = jax.lax.dynamic_slice(seq_buf, (0, start), (1, t))
+    pos = start + jnp.arange(t)[None, :]
+    cache = replace(cache, length=length.astype(jnp.int32))
+    logits, cache = decoder_forward(cfg, params, tokens, cache, pos)
+    return logits, cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "k", "max_new", "eos_ids", "ngram"),
+)
+def _spec_loop(
+    cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    params: dict,
+    draft_params: dict | None,   # None => prompt-lookup mode
+    cache,                       # target cache, prefilled through n_p-1
+    draft_cache,                 # draft cache (unused in lookup mode)
+    seq_buf: jnp.ndarray,        # [1, S] prompt + first token at n_p
+    n_prompt: jnp.ndarray,       # scalar: prompt length
+    k: int,
+    max_new: int,
+    eos_ids: tuple[int, ...],
+    ngram: int = 2,
+):
+    """Speculative rounds until max_new tokens (or EOS).  Returns
+    (seq_buf, n_generated, n_rounds, n_drafted, n_matched)."""
+    eos = jnp.asarray(eos_ids, jnp.int32) if eos_ids else None
+    s_max = seq_buf.shape[1]
+
+    def is_eos(t):
+        if eos is None:
+            return jnp.zeros(jnp.shape(t), bool)
+        return (t[..., None] == eos).any(-1)
+
+    def draft_model_candidates(seq, n, draft_cache):
+        """Draft k tokens with the draft model (self-speculative path)."""
+        # catch-up: 2-token step over [t_{n-2}, t_{n-1}] heals the cache hole
+        # left by a fully-accepted previous round (see module docstring)
+        logits, draft_cache = _forward_at(
+            draft_cfg, draft_params, draft_cache, seq, n - 2, 2, n - 2
+        )
+        d1 = _greedy(logits[:, -1])
+
+        def step(carry, _):
+            tok, dc = carry
+            pos = dc.length[None, None]  # [1,1]
+            lg, dc = decoder_forward(draft_cfg, draft_params, tok, dc, pos)
+            nxt = _greedy(lg[:, -1])[:, None]  # [1,1]
+            return (nxt, dc), tok[0]
+
+        (last, draft_cache), drafted = jax.lax.scan(
+            step, (d1[:, None], draft_cache), None, length=k - 1
+        )
+        # drafted: [k-1, 1] consumed tokens d1..d_{k-1}; add final d_k
+        drafts = jnp.concatenate([drafted[:, 0], last[0]])  # [k]
+        return drafts, draft_cache
+
+    def lookup_candidates(seq, n, draft_cache):
+        """Propose k tokens by matching the trailing n-gram in seq[0:n]."""
+        ng = ngram
+        tail = jax.lax.dynamic_slice(seq, (0, n - ng), (1, ng))[0]  # [ng]
+        idx = jnp.arange(s_max)
+        # windows[i] == seq[0, i:i+ng]
+        m = jnp.ones((s_max,), bool)
+        for j in range(ng):
+            m &= jnp.roll(seq[0], -j) == tail[j]
+        # a *previous* occurrence: window entirely inside [0, n-ng)
+        valid = m & (idx + ng <= n - ng)
+        any_match = valid.any()
+        best = jnp.where(valid, idx, -1).max()
+        start = jnp.where(any_match, best + ng, 0)
+        cand = jax.lax.dynamic_slice(seq, (0, start), (1, k))[0]
+        # no match: propose pad tokens (they will simply fail verification)
+        drafts = jnp.where(any_match, cand, -jnp.ones((k,), jnp.int32))
+        return drafts, draft_cache
+
+    candidates = lookup_candidates if draft_params is None else draft_model_candidates
+
+    def cond(st):
+        return (st["n_new"] < max_new) & ~st["done"]
+
+    def body(st):
+        seq, n = st["seq"], st["n"]
+        drafts, dcache = candidates(seq, n, st["draft_cache"])
+
+        # verify: ONE target forward over [cur, d1..dk]
+        verify_buf = jax.lax.dynamic_update_slice(
+            seq, drafts[None, :], (0, n)
+        )
+        logits, tcache = _forward_at(
+            cfg, params, st["cache"], verify_buf, n - 1, k + 1, n - 1
+        )
+        g = _greedy(logits[0])                      # [k+1] target greedy
+        match = drafts == g[:k]                     # [k]
+        n_acc = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((1,), bool)])
+        ).astype(jnp.int32)                         # leading-match run length
+
+        # accepted tokens this round: d1..d_{n_acc} then bonus g[n_acc]
+        acc = jnp.where(jnp.arange(k + 1) < n_acc, g[: k + 1], g[n_acc])
+        # stop at the first EOS inside the accepted run
+        eos_hit = is_eos(acc) & (jnp.arange(k + 1) <= n_acc)
+        any_eos = eos_hit.any()
+        first_eos = jnp.argmax(eos_hit).astype(jnp.int32)
+        n_take = jnp.where(any_eos, first_eos + 1, n_acc + 1)
+        # budget clip
+        n_take = jnp.minimum(n_take, max_new - st["n_new"])
+
+        window_old = jax.lax.dynamic_slice(seq, (0, n), (1, k + 1))
+        window = jnp.where(jnp.arange(k + 1)[None, :] < n_take, acc[None, :],
+                           window_old)
+        seq = jax.lax.dynamic_update_slice(seq, window, (0, n))
+
+        n2 = n + n_take
+        tcache = replace(tcache, length=(n2 - 1).astype(jnp.int32))
+        return {
+            "seq": seq, "n": n2, "n_new": st["n_new"] + n_take,
+            "cache": tcache, "draft_cache": dcache,
+            "done": st["done"] | any_eos,
+            "rounds": st["rounds"] + 1,
+            "drafted": st["drafted"] + k,
+            "matched": st["matched"] + n_acc,
+        }
+
+    st = {
+        "seq": seq_buf,
+        "n": n_prompt + 1,
+        "n_new": jnp.asarray(1, jnp.int32),
+        "cache": cache,
+        "draft_cache": draft_cache,
+        "done": is_eos(seq_buf[0, n_prompt]),
+        "rounds": jnp.asarray(0, jnp.int32),
+        "drafted": jnp.asarray(0, jnp.int32),
+        "matched": jnp.asarray(0, jnp.int32),
+    }
+    st = jax.lax.while_loop(cond, body, st)
+    return st["seq"], st["n_new"], st["rounds"], st["drafted"], st["matched"]
+
+
+def speculative_generate(
+    cfg: ModelConfig,
+    params: dict,
+    input_ids: Any,
+    generation_config: GenerationConfig,
+    draft_params: dict | None = None,
+    draft_cfg: ModelConfig | None = None,
+    max_step_draft: int = 6,
+    lookup: bool = False,
+    ngram_size: int = 2,
+    mesh=None,
+) -> GenerateResult:
+    """Speculative (or prompt-lookup when ``lookup=True``) greedy decoding.
+
+    ``draft_params`` defaults to the target params (still profitable when the
+    verify forward amortizes weight reads over k+1 tokens).  Batch size 1,
+    greedy only — matching the reference's supported envelope
+    (speculative.py:811 asserts bs==1).
+    """
+    gen = generation_config
+    if gen.do_sample:
+        raise NotImplementedError("speculative decoding is greedy-only")
+    from ipex_llm_tpu.ops import dispatch as _dispatch
+
+    with _dispatch.spmd(mesh is not None and mesh.size > 1):
+        return _speculative_inner(
+            cfg, params, input_ids, gen, draft_params, draft_cfg,
+            max_step_draft, lookup, ngram_size, mesh,
+        )
+
+
+def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
+                       max_step_draft, lookup, ngram_size, mesh):
+    tokens, lengths, tpad = pad_batch(input_ids, gen.pad_token_id, bucket=1)
+    if tokens.shape[0] != 1:
+        raise ValueError("speculative decoding supports batch size 1")
+    n_p = int(lengths[0])
+    k = max_step_draft
+
+    if lookup:
+        draft_params = None
+        draft_cfg = cfg
+    else:
+        draft_params = draft_params if draft_params is not None else params
+        draft_cfg = draft_cfg or cfg
+
+    same_weights = draft_params is params
+    s_max = _round_up(n_p + gen.max_new_tokens + k + 2, DECODE_BLOCK)
+    cache = kv_mod.make_cache(
+        "normal", cfg.num_layers, 1, s_max, cfg.num_kv_heads, cfg.head_dim
+    )
+    if lookup:
+        # unused by the lookup path; a 1-slot dummy avoids donating the
+        # target cache buffers twice
+        draft_cache = kv_mod.make_cache("normal", 1, 1, 1, 1, 1)
+    elif not same_weights:
+        draft_cache = kv_mod.make_cache(
+            "normal", draft_cfg.num_layers, 1, s_max, draft_cfg.num_kv_heads,
+            draft_cfg.head_dim,
+        )
+    if mesh is not None:
+        from ipex_llm_tpu.parallel import shard as shard_mod
+
+        cache = shard_mod.shard_cache(cache, mesh)
+        if not lookup and not same_weights:
+            draft_cache = shard_mod.shard_cache(draft_cache, mesh)
+
+    seq_buf = np.zeros((1, s_max), np.int32)
+    seq_buf[0, :n_p] = tokens[0, tpad - n_p:]
+    seq_buf = jnp.asarray(seq_buf)
+
+    # prefill both models; sample the first token from the target
+    t0 = time.perf_counter()
+    pos = jnp.arange(n_p)[None, :]
+    logits, cache = decoder_forward(
+        cfg, params, seq_buf[:, :n_p], cache, pos, last_token_only=True
+    )
+    if not lookup and same_weights:
+        # self-speculative with byte-identical weights: the draft cache is a
+        # copy of the target's prefilled K/V (one prompt pass, not two);
+        # every leaf must be a fresh buffer — both caches are donated
+        draft_cache = replace(
+            cache, k=jnp.copy(cache.k), v=jnp.copy(cache.v),
+            length=jnp.copy(cache.length),
+        )
+    elif not lookup:
+        _, draft_cache = decoder_forward(
+            draft_cfg, draft_params, seq_buf[:, :n_p], draft_cache, pos,
+            last_token_only=True,
+        )
+    first = _greedy(logits)
+    seq_buf = jax.lax.dynamic_update_slice(seq_buf, first[None], (0, n_p))
+    jax.block_until_ready(first)
+    ttft = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    seq_buf, n_new, rounds, drafted, matched = _spec_loop(
+        cfg, draft_cfg, params,
+        None if lookup else draft_params,
+        cache, draft_cache, seq_buf, jnp.asarray(n_p, jnp.int32),
+        k, gen.max_new_tokens, gen.eos_token_id, ngram=ngram_size,
+    )
+    seq = np.asarray(seq_buf)
+    n_new = int(n_new)
+    dt = time.perf_counter() - t1
+
+    res = GenerateResult(
+        sequences=seq[:, : n_p + n_new],
+        num_prompt_tokens=n_p,
+        num_new_tokens=np.asarray([n_new], np.int32),
+        first_token_s=ttft,
+        rest_token_s=dt / max(n_new - 1, 1),
+    )
+    # reference-style acceptance telemetry (speculative.py clear_benchmarks)
+    res.n_rounds = int(rounds)
+    res.n_drafted = int(drafted)
+    res.n_matched = int(matched)
+    return res

@@ -86,6 +86,7 @@ class TPUModelForCausalLM:
         qtype = _resolve_qtype(kwargs)
         mixed_precision = kwargs.pop("mixed_precision", False)
         mesh = kwargs.pop("mesh", None)
+        speculative = kwargs.pop("speculative", False)
         kwargs.pop("optimize_model", True)
         kwargs.pop("torch_dtype", None)
         kwargs.pop("trust_remote_code", None)
@@ -99,6 +100,18 @@ class TPUModelForCausalLM:
             qtype=qtype, mixed_precision=mixed_precision,
         )
         model = cls(cfg, params, hf_config, qtype)
+        if speculative:
+            # reference model.py:366-376: draft = sym_int4 copy of the same
+            # checkpoint (no separate draft weights)
+            canonical = qtypes.resolve(qtype).name
+            if canonical in ("sym_int4", "asym_int4", "nf4", "fp4"):
+                model.draft_model = model
+            else:
+                draft_params = build_params(
+                    cfg, family.scheme, reader.get, reader.has,
+                    qtype="sym_int4",
+                )
+                model.draft_model = cls(cfg, draft_params, hf_config, "sym_int4")
         if mesh is not None:
             model.shard(mesh)
         return model
@@ -114,6 +127,9 @@ class TPUModelForCausalLM:
 
         self.params = shard_params(self.params, mesh)
         self.mesh = mesh
+        draft = getattr(self, "draft_model", None)
+        if draft is not None and draft is not self and draft.mesh is not mesh:
+            draft.shard(mesh)
         return self
 
     @classmethod
@@ -151,8 +167,7 @@ class TPUModelForCausalLM:
         tokens_j = jnp.asarray(tokens)
         from ipex_llm_tpu.ops import dispatch as _dispatch
 
-        _dispatch.set_spmd(self.mesh is not None and self.mesh.size > 1)
-        try:
+        with _dispatch.spmd(self.mesh is not None and self.mesh.size > 1):
             if self.mesh is not None:
                 from ipex_llm_tpu.parallel.shard import shard_batch, shard_cache
 
@@ -161,8 +176,6 @@ class TPUModelForCausalLM:
             logits, _ = decoder_forward(
                 self.config, self.params, tokens_j, cache, pos
             )
-        finally:
-            _dispatch.set_spmd(False)
         return logits
 
     def generate(
@@ -184,18 +197,7 @@ class TPUModelForCausalLM:
         else:
             rows = list(tokens)
 
-        gcfg = generation_config or self.generation_config
-        fields = {
-            k: kwargs.pop(k)
-            for k in list(kwargs)
-            if k in GenerationConfig.__dataclass_fields__
-        }
-        if "eos_token_id" in fields and isinstance(fields["eos_token_id"], int):
-            fields["eos_token_id"] = (fields["eos_token_id"],)
-        if fields:
-            from dataclasses import replace
-
-            gcfg = replace(gcfg, **fields)
+        gcfg = (generation_config or self.generation_config).with_kwargs(kwargs)
 
         stream_cb = None
         if streamer is not None:
@@ -210,6 +212,59 @@ class TPUModelForCausalLM:
             streamer.end()
         self.first_cost = res.first_token_s
         self.rest_cost_mean = res.rest_token_s
+        out = res.sequences
+        if was_torch:
+            import torch
+
+            return torch.from_numpy(np.ascontiguousarray(out)).long()
+        return out
+
+    def speculative_generate(
+        self,
+        input_ids: Any = None,
+        draft_model: "TPUModelForCausalLM | None" = None,
+        max_step_draft: int = 6,
+        **kwargs,
+    ):
+        """Self-speculative greedy decoding (reference speculative.py:805).
+
+        ``draft_model`` defaults to this model's own weights — load with
+        ``from_pretrained(..., speculative=True)`` to attach a sym_int4
+        draft of the same checkpoint like the reference (model.py:366-376).
+        """
+        return self._spec_generate(input_ids, draft_model, max_step_draft,
+                                   False, 0, kwargs)
+
+    def lookup_generate(self, input_ids: Any = None, max_matching_ngram_size:
+                        int = 2, num_output_tokens: int = 6, **kwargs):
+        """Prompt-lookup decoding (reference lookup.py:274)."""
+        return self._spec_generate(input_ids, None, num_output_tokens,
+                                   True, max_matching_ngram_size, kwargs)
+
+    def _spec_generate(self, input_ids, draft_model, k, lookup, ngram, kwargs):
+        from ipex_llm_tpu.speculative import speculative_generate as _spec
+
+        was_torch = _is_torch(input_ids)
+        tokens = np.asarray(_to_numpy(input_ids), np.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        gcfg = kwargs.pop("generation_config", None) or self.generation_config
+        gcfg = gcfg.with_kwargs(kwargs)
+        draft = draft_model if draft_model is not None else getattr(
+            self, "draft_model", None
+        )
+        res = _spec(
+            self.config, self.params, list(tokens), gcfg,
+            draft_params=None if draft is None else draft.params,
+            draft_cfg=None if draft is None else draft.config,
+            max_step_draft=k, lookup=lookup,
+            ngram_size=ngram or 2,
+            mesh=self.mesh,
+        )
+        self.first_cost = res.first_token_s
+        self.rest_cost_mean = res.rest_token_s
+        self.n_matched = getattr(res, "n_matched", 0)
+        self.n_drafted = getattr(res, "n_drafted", 0)
         out = res.sequences
         if was_torch:
             import torch

@@ -1,0 +1,129 @@
+"""Speculative / prompt-lookup decoding correctness.
+
+The core guarantee (same as the reference's design, speculative.py:805): with
+greedy verification, speculative output is token-identical to plain greedy
+decoding of the target model, for ANY draft — the draft only changes speed.
+"""
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.generation import GenerationConfig, generate
+from ipex_llm_tpu.speculative import speculative_generate
+from tests.test_decoder import rand_params, tiny_cfg
+
+RNG = np.random.default_rng(3)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=101, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=4, head_dim=12)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+@pytest.fixture(scope="module")
+def greedy_ref(cfg_params):
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(0, cfg.vocab_size, 24))
+    gen = GenerationConfig(max_new_tokens=24, do_sample=False)
+    want = generate(cfg, params, [prompt], gen)
+    return prompt, gen, want
+
+
+def test_self_speculative_matches_greedy(cfg_params, greedy_ref):
+    cfg, params = cfg_params
+    prompt, gen, want = greedy_ref
+    got = speculative_generate(cfg, params, [prompt], gen, max_step_draft=4)
+    n = int(want.num_new_tokens[0])
+    np.testing.assert_array_equal(
+        got.sequences[0, : len(prompt) + n], want.sequences[0, : len(prompt) + n]
+    )
+    # same-weights draft under greedy: every draft token must be accepted
+    assert got.n_matched == got.n_drafted
+    assert got.n_rounds < n
+
+
+def test_int4_draft_matches_greedy(cfg_params, greedy_ref):
+    """A *different* (quantized) draft must not change the output."""
+    cfg, params = cfg_params
+    prompt, gen, want = greedy_ref
+    draft_params = rand_params(cfg, qtype="sym_int4")
+    got = speculative_generate(
+        cfg, params, [prompt], gen, draft_params=draft_params, max_step_draft=4
+    )
+    n = int(want.num_new_tokens[0])
+    np.testing.assert_array_equal(
+        got.sequences[0, : len(prompt) + n], want.sequences[0, : len(prompt) + n]
+    )
+
+
+def test_lookup_matches_greedy(cfg_params, greedy_ref):
+    cfg, params = cfg_params
+    prompt, gen, want = greedy_ref
+    got = speculative_generate(cfg, params, [prompt], gen, lookup=True,
+                               max_step_draft=4)
+    n = int(want.num_new_tokens[0])
+    np.testing.assert_array_equal(
+        got.sequences[0, : len(prompt) + n], want.sequences[0, : len(prompt) + n]
+    )
+
+
+def test_lookup_accepts_repeated_pattern(cfg_params):
+    """A prompt with a repeating n-gram must yield accepted lookup drafts."""
+    cfg, params = cfg_params
+    pat = [5, 6, 7, 8, 9, 10]
+    prompt = pat * 4
+    gen = GenerationConfig(max_new_tokens=16, do_sample=False)
+    want = generate(cfg, params, [prompt], gen)
+    got = speculative_generate(cfg, params, [prompt], gen, lookup=True,
+                               max_step_draft=4)
+    n = int(want.num_new_tokens[0])
+    np.testing.assert_array_equal(
+        got.sequences[0, : len(prompt) + n], want.sequences[0, : len(prompt) + n]
+    )
+
+
+def test_eos_stops_speculative(cfg_params):
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(0, cfg.vocab_size, 12))
+    gen = GenerationConfig(max_new_tokens=32, do_sample=False)
+    base = generate(cfg, params, [prompt], gen)
+    # pick the 3rd generated token as "EOS" and re-run with it active
+    eos = int(base.sequences[0, len(prompt) + 2])
+    gen_eos = GenerationConfig(max_new_tokens=32, do_sample=False,
+                               eos_token_id=(eos,))
+    got = speculative_generate(cfg, params, [prompt], gen_eos, max_step_draft=4)
+    n = int(got.num_new_tokens[0])
+    assert n <= 3 or eos in got.sequences[0, len(prompt):len(prompt) + n]
+    seq = got.sequences[0, len(prompt):len(prompt) + n]
+    # nothing after the first EOS
+    if eos in list(seq[:-1]):
+        assert list(seq).index(eos) == n - 1
+
+
+def test_model_api_speculative(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf_cfg).save_pretrained(str(tmp_path), safe_serialization=True)
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        str(tmp_path), load_in_low_bit="bf16", speculative=True
+    )
+    assert model.draft_model is not model  # bf16 target gets an int4 draft
+    prompt = np.arange(10, 26, dtype=np.int32)
+    want = model.generate(prompt, max_new_tokens=8)
+    got = model.speculative_generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(got[0], want[0])
+    lk = model.lookup_generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(lk[0], want[0])
