@@ -1,0 +1,120 @@
+"""SnapKV-style KV-cache compression.
+
+Reference counterpart: ``compress_kv`` + ``DynamicCompressCache`` (reference
+kv.py:221-293, gate ``should_use_compresskv`` models/utils.py:360): after
+prefill of a long prompt, attention scores from the last-``W`` "observation
+window" queries rank every earlier KV slot; only the top-``C`` slots (plus
+the window itself) are kept, shrinking KV HBM for long-context decode.
+
+TPU-native: compression is a pure jitted transform on the cache pytree —
+top-k + gather per (batch, kv-head) with static output capacity, so decode
+re-jits only once for the compressed shape.  Slot indices renumber after the
+gather but K vectors keep their original RoPE phases, and the generate loop
+tracks logical positions separately from cache slots, so decode needs no
+special-casing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.kv import KVCache
+
+OBS_WINDOW = 32       # reference kv.py window_sizes
+DEFAULT_CAPACITY = 512  # kept slots outside the window (reference max_capacity_prompts ~ 512-2048)
+
+
+def window() -> int:
+    import os
+
+    return int(os.environ.get("IPEX_LLM_TPU_KV_OBS_WINDOW", OBS_WINDOW))
+
+
+def capacity() -> int:
+    import os
+
+    return int(os.environ.get("IPEX_LLM_TPU_KV_CAPACITY", DEFAULT_CAPACITY))
+
+
+def use_compress_kv(prompt_len: int) -> bool:
+    """Opt-in via IPEX_LLM_TPU_COMPRESS_KV_CACHE=1 (reference env
+    IPEX_LLM_COMPRESS_KV_CACHE) and only profitable for prompts longer than
+    the kept capacity."""
+    import os
+
+    flag = os.environ.get(
+        "IPEX_LLM_TPU_COMPRESS_KV_CACHE",
+        os.environ.get("IPEX_LLM_COMPRESS_KV_CACHE", ""),
+    )
+    return flag == "1" and prompt_len > capacity() + window()
+
+
+@partial(jax.jit, static_argnames=("capacity", "window", "new_total"))
+def compress(
+    cache: KVCache,
+    obs_q: jnp.ndarray,            # [L, B, W, Hq, D] post-RoPE window queries
+    kv_start: jnp.ndarray | None,  # [B] first valid slot (left padding)
+    capacity: int,
+    window: int,
+    new_total: int,                # static: capacity + window + decode slack
+) -> KVCache:
+    """Shrink a prefilled cache to ``capacity`` ranked slots + the window."""
+    l, b, s, hkv, d = cache.k.shape
+    w = window
+    hq = obs_q.shape[3]
+    n_rep = hq // hkv
+    length = cache.length                      # prompt end slot (scalar)
+
+    k = cache.decode_layer(cache.k)            # [L,B,S,Hkv,D]
+    # scores: window queries vs all keys, grouped to kv heads
+    qf = obs_q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("lbwhd,lbshd->lbhws", qf,
+                        jnp.repeat(kf, n_rep, axis=3) if n_rep > 1 else kf)
+    scores = scores * (d ** -0.5)
+    # mask invalid slots: before kv_start (left pad) and at/after length-w
+    slot = jnp.arange(s)
+    valid = slot[None, :] < (length - w)
+    if kv_start is not None:
+        valid = valid & (slot[None, :] >= kv_start[:, None])
+    else:
+        valid = jnp.broadcast_to(valid, (b, s))
+    scores = jnp.where(valid[None, :, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)    # [L,B,Hkv*rep? ...]
+    # group query heads back onto their kv head and sum over the window
+    probs = probs.reshape(l, b, hkv, n_rep, w, s).sum(axis=(3, 4))  # [L,B,Hkv,S]
+    # reference smooths with a pool before top-k (kv.py: avg_pool1d)
+    pooled = jax.lax.reduce_window(
+        probs, 0.0, jax.lax.add, (1, 1, 1, 5), (1, 1, 1, 1), "SAME"
+    ) / 5.0
+    pooled = jnp.where(valid[None, :, None, :], pooled, -jnp.inf)
+
+    _, keep = jax.lax.top_k(pooled, capacity)            # [L,B,Hkv,C]
+    keep = jnp.sort(keep, axis=-1)                       # preserve slot order
+
+    def gather_layerwise(buf):                           # [L,B,S,Hkv,Dx]
+        moved = jnp.moveaxis(buf, 3, 2)                  # [L,B,Hkv,S,Dx]
+        picked = jnp.take_along_axis(
+            moved, keep[..., None], axis=3
+        )                                                # [L,B,Hkv,C,Dx]
+        win = jax.lax.dynamic_slice_in_dim(
+            moved, length - w, w, axis=3
+        )                                                # [L,B,Hkv,W,Dx]
+        newbuf = jnp.concatenate([picked, win], axis=3)  # [L,B,Hkv,C+W,Dx]
+        pad = new_total - (capacity + w)
+        if pad:
+            newbuf = jnp.pad(newbuf, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+        return jnp.moveaxis(newbuf, 2, 3)                # [L,B,new,Hkv,Dx]
+
+    new_k = gather_layerwise(cache.k.astype(cache.k.dtype))
+    new_v = gather_layerwise(cache.v)
+    return replace(
+        cache,
+        k=new_k,
+        v=new_v,
+        length=jnp.asarray(capacity + w, jnp.int32),
+    )

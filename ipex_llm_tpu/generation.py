@@ -139,6 +139,20 @@ def prefill_step(
     return logits, cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "obs"), donate_argnums=(2,))
+def prefill_collect_step(cfg: ModelConfig, params: dict, cache, tokens,
+                         lengths, obs: int):
+    """Prefill that also returns the SnapKV observation-window queries."""
+    b, tpad = tokens.shape
+    kv_start = (tpad - lengths).astype(jnp.int32)
+    pos = jnp.maximum(jnp.arange(tpad)[None, :] - kv_start[:, None], 0)
+    logits, cache, obs_q = decoder_forward(
+        cfg, params, tokens, cache, pos, kv_start=kv_start,
+        last_token_only=True, collect_obs=obs,
+    )
+    return logits, cache, obs_q
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "gen", "max_steps"),
@@ -244,24 +258,42 @@ def generate(
     gen = generation_config
     tokens, lengths, tpad = pad_batch(input_ids, gen.pad_token_id)
     b = tokens.shape[0]
-    capacity = tpad + _round_up(gen.max_new_tokens + 1, DECODE_BLOCK)
 
+    compress = kv_kind == "compress"
     if kv_kind == "auto":
-        kv_kind = "fp8" if kv_mod.use_quantize_kv_cache() else "normal"
-    cache = kv_mod.make_cache(
-        kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
-    )
+        from ipex_llm_tpu import compresskv
+
+        if (
+            compresskv.use_compress_kv(int(lengths.min()))
+            and cfg.sliding_window is None
+        ):
+            compress, kv_kind = True, "compress"
+        else:
+            kv_kind = "fp8" if kv_mod.use_quantize_kv_cache() else "normal"
+    if compress:
+        # prefill-only cache; decode runs in the compressed cache
+        capacity = tpad
+        cache = kv_mod.make_cache(
+            "normal", cfg.num_layers, b, capacity, cfg.num_kv_heads,
+            cfg.head_dim,
+        )
+    else:
+        capacity = tpad + _round_up(gen.max_new_tokens + 1, DECODE_BLOCK)
+        cache = kv_mod.make_cache(
+            kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
+        )
 
     from ipex_llm_tpu.ops import dispatch as _dispatch
 
     with _dispatch.spmd(mesh is not None and mesh.size > 1):
         return _generate_inner(
-            cfg, params, gen, tokens, lengths, tpad, b, cache, mesh, streamer
+            cfg, params, gen, tokens, lengths, tpad, b, cache, mesh, streamer,
+            compress,
         )
 
 
 def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
-                    streamer):
+                    streamer, compress=False):
     tokens_j = jnp.asarray(tokens)
     lengths_j = jnp.asarray(lengths)
     if mesh is not None:
@@ -271,7 +303,20 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
         tokens_j, lengths_j = shard_mod.shard_batch(mesh, b, tokens_j, lengths_j)
 
     t0 = time.perf_counter()
-    logits, cache = prefill_step(cfg, params, cache, tokens_j, lengths_j)
+    if compress:
+        from ipex_llm_tpu import compresskv
+
+        w, cap = compresskv.window(), compresskv.capacity()
+        logits, cache, obs_q = prefill_collect_step(
+            cfg, params, cache, tokens_j, lengths_j, w
+        )
+        new_total = cap + w + _round_up(gen.max_new_tokens + 1, DECODE_BLOCK)
+        cache = compresskv.compress(
+            cache, obs_q, jnp.asarray((tpad - lengths).astype(np.int32)),
+            cap, w, new_total,
+        )
+    else:
+        logits, cache = prefill_step(cfg, params, cache, tokens_j, lengths_j)
     key = jax.random.PRNGKey(gen.seed)
     key, sub = jax.random.split(key)
     prev_ring = jnp.asarray(_init_prev_ring(tokens, lengths))
@@ -284,7 +329,11 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
     # the first sampled token joins the penalty window immediately
     prev_ring = prev_ring.at[jnp.arange(b), lengths_j % REP_WINDOW].set(first)
 
-    kv_start = jnp.asarray((tpad - lengths).astype(np.int32))
+    if compress:
+        # compression gathers only valid slots and renumbers them from 0
+        kv_start = jnp.zeros((b,), jnp.int32)
+    else:
+        kv_start = jnp.asarray((tpad - lengths).astype(np.int32))
     if mesh is not None:
         from ipex_llm_tpu.parallel import shard as shard_mod
 

@@ -1,0 +1,14 @@
+"""GGUF import: file reader + model builder.
+
+Reference counterpart: ``transformers/gguf/api.py:31 load_gguf_model`` and
+the per-family loaders under transformers/gguf/models/ (§2.1 "GGUF import").
+TPU-native differences: quantized tensors are *not* dequantized to torch —
+ggml blocks are repacked bit-exactly into ``QTensor`` planes (q4_0/q4_1/
+q8_0) or kept as raw superblock bytes decoded in-jit (k-quants, see
+quantize/kquants.py), so a GGUF model runs quantized end-to-end.
+"""
+
+from ipex_llm_tpu.gguf.reader import GGUFReader
+from ipex_llm_tpu.gguf.api import load_gguf_model
+
+__all__ = ["GGUFReader", "load_gguf_model"]

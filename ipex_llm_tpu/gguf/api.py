@@ -1,0 +1,158 @@
+"""Build a runnable model straight from a .gguf file.
+
+Reference counterpart: ``load_gguf_model`` (reference transformers/gguf/
+api.py:31) + per-family loaders (gguf/models/llama.py etc).  Weights stay in
+their ggml block formats (repacked via gguf/convert.py); q/k/v and gate/up
+are kept as split projections because llama.cpp mixes qtypes across them
+(e.g. q4_k_m stores attn_v at q6_k).  A slot whose qtype differs across
+*layers* is requantized to sym_int8 so the stacked layer scan stays
+homogeneous (documented deviation; quality ≥ q6_k).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.gguf import convert as gconv
+from ipex_llm_tpu.gguf.reader import GGUFReader
+from ipex_llm_tpu.models.build import stack_layer_trees
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.ops.rope import RopeScaling
+from ipex_llm_tpu.quantize import core as qcore
+from ipex_llm_tpu.quantize.core import QTensor
+
+NORM_DTYPE = jnp.float32
+
+#: architectures sharing the llama-style GGUF tensor naming
+_SUPPORTED_ARCH = ("llama", "mistral", "qwen2", "qwen3", "phi3", "gemma",
+                   "gemma2", "starcoder2", "internlm2")
+
+
+def _meta_config(rd: GGUFReader) -> ModelConfig:
+    md = rd.metadata
+    arch = md.get("general.architecture", "llama")
+    if arch not in _SUPPORTED_ARCH:
+        raise NotImplementedError(f"GGUF architecture {arch!r}")
+
+    def g(key: str, default=None):
+        return md.get(f"{arch}.{key}", default)
+
+    hidden = int(g("embedding_length"))
+    heads = int(g("attention.head_count"))
+    head_dim = int(g("attention.key_length", hidden // heads))
+    vocab = rd.tensors["token_embd.weight"].shape[0]
+    rope_base = float(g("rope.freq_base", 10000.0))
+    rs = RopeScaling(
+        head_dim=head_dim,
+        base=rope_base,
+        kind="linear" if g("rope.scale_linear") else "default",
+        factor=float(g("rope.scale_linear", 1.0)),
+    )
+    return ModelConfig(
+        model_type=str(arch),
+        vocab_size=int(vocab),
+        hidden_size=hidden,
+        intermediate_size=int(g("feed_forward_length")),
+        num_layers=int(g("block_count")),
+        num_heads=heads,
+        num_kv_heads=int(g("attention.head_count_kv", heads)),
+        head_dim=head_dim,
+        max_position_embeddings=int(g("context_length", 4096)),
+        norm_eps=float(g("attention.layer_norm_rms_epsilon", 1e-5)),
+        rope=rs,
+        qk_norm=f"blk.0.attn_q_norm.weight" in rd.tensors,
+        tie_word_embeddings="output.weight" not in rd.tensors,
+        attention_bias="blk.0.attn_q.bias" in rd.tensors,
+    )
+
+
+_LAYER_SLOTS = {
+    "q": "attn_q", "k": "attn_k", "v": "attn_v", "o": "attn_output",
+    "gate": "ffn_gate", "up": "ffn_up", "down": "ffn_down",
+}
+_LAYER_NORMS = {
+    "attn_norm": "attn_norm", "mlp_norm": "ffn_norm",
+    "q_norm": "attn_q_norm", "k_norm": "attn_k_norm",
+}
+
+
+def _load_qtensor(rd: GGUFReader, name: str) -> QTensor:
+    info = rd.tensors[name]
+    return gconv.to_qtensor(rd.raw(name), info.shape, rd.astype_name(name))
+
+
+def _requantize(qt: QTensor, qtype: str) -> QTensor:
+    w = qcore.dequantize(qt)  # [in, out]
+    return qcore.quantize(np.asarray(w), qtype)
+
+
+def load_gguf_model(path: str) -> tuple[ModelConfig, dict[str, Any], dict]:
+    """Parse + repack a GGUF file.  Returns (cfg, params, hf_config_dict)."""
+    rd = GGUFReader(path)
+    cfg = _meta_config(rd)
+
+    layers: list[dict[str, Any]] = []
+    for i in range(cfg.num_layers):
+        lp: dict[str, Any] = {}
+        for key, stem in _LAYER_NORMS.items():
+            name = f"blk.{i}.{stem}.weight"
+            if name in rd.tensors:
+                info = rd.tensors[name]
+                lp[key] = jnp.asarray(
+                    gconv.to_dense(rd.raw(name), info.shape,
+                                   rd.astype_name(name)),
+                    NORM_DTYPE,
+                )
+        for key, stem in _LAYER_SLOTS.items():
+            name = f"blk.{i}.{stem}.weight"
+            lp[key] = _load_qtensor(rd, name)
+            bias = f"blk.{i}.{stem}.bias"
+            if bias in rd.tensors:
+                binfo = rd.tensors[bias]
+                lp[key + "_bias"] = jnp.asarray(
+                    gconv.to_dense(rd.raw(bias), binfo.shape,
+                                   rd.astype_name(bias)),
+                    jnp.float32,
+                )
+        layers.append(lp)
+
+    # homogenize per-slot qtypes across layers (scan needs one layout)
+    for key in _LAYER_SLOTS:
+        qtypes_seen = {layers[i][key].qtype for i in range(cfg.num_layers)}
+        if len(qtypes_seen) > 1:
+            for i in range(cfg.num_layers):
+                layers[i][key] = _requantize(layers[i][key], "sym_int8")
+
+    params: dict[str, Any] = {"layers": stack_layer_trees(layers)}
+    emb_info = rd.tensors["token_embd.weight"]
+    params["embed"] = jnp.asarray(
+        gconv.to_dense(rd.raw("token_embd.weight"), emb_info.shape,
+                       rd.astype_name("token_embd.weight")),
+        jnp.bfloat16,
+    )
+    norm_info = rd.tensors["output_norm.weight"]
+    params["final_norm"] = jnp.asarray(
+        gconv.to_dense(rd.raw("output_norm.weight"), norm_info.shape,
+                       rd.astype_name("output_norm.weight")),
+        NORM_DTYPE,
+    )
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _load_qtensor(rd, "output.weight")
+    if cfg.rope is not None:
+        params["inv_freq"] = jnp.asarray(
+            cfg.rope.inv_freq(cfg.max_position_embeddings), jnp.float32
+        )
+        params["rope_mscale"] = float(cfg.rope.mscale(cfg.max_position_embeddings))
+
+    hf_config = {
+        "model_type": cfg.model_type,
+        "vocab_size": cfg.vocab_size,
+        "eos_token_id": rd.metadata.get("tokenizer.ggml.eos_token_id"),
+        "bos_token_id": rd.metadata.get("tokenizer.ggml.bos_token_id"),
+        "_gguf_source": path,
+    }
+    rd.close()
+    return cfg, params, hf_config

@@ -1,0 +1,152 @@
+"""ggml block-format → QTensor repack (host-side numpy, bit-exact).
+
+The layout-convert layer: the reference ships native
+``ggml_q_format_convet_cpu2xpu`` converters to move ggml blocks into its XPU
+kernel layout (reference low_bit_linear.py:198-253); here the equivalents are
+vectorized numpy repacks into the QTensor planes of quantize/core.py:
+
+- q4_0 → sym_int4 and q8_0 → sym_int8 and q4_1 → asym_int4 are **bit-exact**
+  (same 32-block, same nibble-halves pairing, fp16 scales preserved);
+- q5_0/q5_1 → sym_int5/asym_int5 are bit-exact (codes one-per-byte);
+- k-quants (q2_k..q6_k) keep their raw superblock bytes and decode in-jit
+  (quantize/kquants.py);
+- f16/f32/bf16 pass through as dense arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ipex_llm_tpu.quantize.core import QTensor
+
+
+def _f16(u16: np.ndarray) -> np.ndarray:
+    return u16.view(np.float16).astype(np.float32)
+
+
+def _blocks(raw: np.ndarray, n_rows: int, block_bytes: int) -> np.ndarray:
+    """raw uint8 -> [rows, n_blocks, block_bytes]."""
+    return raw.reshape(n_rows, -1, block_bytes)
+
+
+def _pack_from_row_codes(codes: np.ndarray, bs: int) -> np.ndarray:
+    """codes [out, in] uint8 -> QTensor data plane [in//2, out] (halves)."""
+    out, n_in = codes.shape
+    c = codes.reshape(out, n_in // bs, bs)
+    lo, hi = c[:, :, : bs // 2], c[:, :, bs // 2 :]
+    packed = (lo | (hi << 4)).astype(np.uint8)        # [out, nb, bs//2]
+    return packed.reshape(out, -1).T.copy()           # [in//2, out]
+
+
+def _q4_0(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    b = _blocks(raw, out, 18)
+    d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])     # [out, nb]
+    qs = b[:, :, 2:]                                           # [out, nb, 16]
+    # ggml byte j pairs rows j / j+16 of the 32-block — the same halves
+    # pairing as _pack_nibbles, so bytes transfer verbatim
+    data = qs.reshape(out, -1).T.copy()                        # [in/2, out]
+    scales = d.T.astype(np.float16)                            # [nb, out]
+    return QTensor(data, scales, None, "sym_int4", (n_in, out), 32)
+
+
+def _q4_1(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    b = _blocks(raw, out, 20)
+    d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])
+    m = _f16(b[:, :, 2:4].copy().view(np.uint16)[:, :, 0])
+    qs = b[:, :, 4:]
+    data = qs.reshape(out, -1).T.copy()
+    return QTensor(data, d.T.astype(np.float16), m.T.astype(np.float16),
+                   "asym_int4", (n_in, out), 32)
+
+
+def _q8_0(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    b = _blocks(raw, out, 34)
+    d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])
+    q = b[:, :, 2:].view(np.int8).astype(np.int16) + 128       # [out, nb, 32]
+    data = q.astype(np.uint8).reshape(out, -1).T.copy()        # [in, out]
+    return QTensor(data, d.T.astype(np.float16), None, "sym_int8",
+                   (n_in, out), 32)
+
+
+def _q5_codes(b: np.ndarray, qs_off: int) -> np.ndarray:
+    """Assemble 5-bit codes [out, nb, 32] from qh bits + nibbles."""
+    qh = b[:, :, qs_off - 4 : qs_off].copy().view(np.uint32)[:, :, 0]  # [out, nb]
+    qs = b[:, :, qs_off:]                                      # [out, nb, 16]
+    lo = np.concatenate([qs & 0x0F, qs >> 4], axis=2)          # [out, nb, 32]
+    shifts = np.arange(32, dtype=np.uint32)
+    hi = ((qh[:, :, None] >> shifts) & 1).astype(np.uint8)
+    return lo | (hi << 4)
+
+def _q5_0(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    b = _blocks(raw, out, 22)
+    d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])
+    codes = _q5_codes(b, 6)
+    data = codes.reshape(out, -1).T.copy()                     # one per byte
+    return QTensor(data, d.T.astype(np.float16), None, "sym_int5",
+                   (n_in, out), 32)
+
+
+def _q5_1(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    b = _blocks(raw, out, 24)
+    d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])
+    m = _f16(b[:, :, 2:4].copy().view(np.uint16)[:, :, 0])
+    codes = _q5_codes(b, 8)
+    data = codes.reshape(out, -1).T.copy()
+    return QTensor(data, d.T.astype(np.float16), m.T.astype(np.float16),
+                   "asym_int5", (n_in, out), 32)
+
+
+def _kquant(raw: np.ndarray, out: int, n_in: int, name: str,
+            block_bytes: int) -> QTensor:
+    data = raw.reshape(out, -1).copy()                         # [out, nb*ts]
+    return QTensor(data, None, None, name, (n_in, out), 256)
+
+
+_CONVERTERS = {
+    "q4_0": _q4_0, "q4_1": _q4_1, "q8_0": _q8_0,
+    "q5_0": _q5_0, "q5_1": _q5_1,
+}
+_KQUANTS = {"q2_k": 84, "q3_k": 110, "q4_k": 144, "q5_k": 176, "q6_k": 210,
+            "q8_k": 292}
+
+
+def to_dense(raw: np.ndarray, shape: tuple[int, ...], type_name: str) -> np.ndarray:
+    """Decode any supported tensor to float32 numpy in its logical shape."""
+    if type_name == "fp32":
+        return raw.view(np.float32).reshape(shape).copy()
+    if type_name == "fp16":
+        return raw.view(np.float16).astype(np.float32).reshape(shape)
+    if type_name == "bf16":
+        u = raw.copy().view(np.uint16).astype(np.uint32) << 16
+        return u.view(np.float32).reshape(shape)
+    if len(shape) == 1:
+        shape = (1, shape[0])
+        qt = to_qtensor(raw, shape, type_name)
+        return np.asarray(_dequant(qt)).reshape(-1)
+    qt = to_qtensor(raw, shape, type_name)
+    return np.asarray(_dequant(qt)).T.copy()  # [in, out] -> [out, in]
+
+
+def _dequant(qt: QTensor):
+    from ipex_llm_tpu.quantize import core as qcore
+
+    return qcore.dequantize(qt)
+
+
+def to_qtensor(raw: np.ndarray, shape: tuple[int, ...], type_name: str) -> QTensor:
+    """Repack a 2-D ggml tensor [out, in] into a QTensor (weights stay
+    quantized).  Falls back to a bf16 QTensor for float types."""
+    if len(shape) != 2:
+        raise ValueError(f"to_qtensor expects 2-D, got {shape}")
+    out, n_in = shape
+    if type_name in ("fp32", "fp16", "bf16"):
+        w = to_dense(raw, shape, type_name)                    # [out, in]
+        import jax.numpy as jnp
+
+        return QTensor(jnp.asarray(w.T, jnp.bfloat16), None, None, "bf16",
+                       (n_in, out), 0)
+    if type_name in _CONVERTERS:
+        return _CONVERTERS[type_name](raw, out, n_in)
+    if type_name in _KQUANTS:
+        return _kquant(raw, out, n_in, type_name, _KQUANTS[type_name])
+    raise NotImplementedError(f"ggml type {type_name} import")

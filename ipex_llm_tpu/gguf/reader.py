@@ -1,0 +1,161 @@
+"""GGUF v2/v3 binary reader (mmap-backed, lazy per-tensor access).
+
+Implements the public GGUF spec (magic "GGUF", little-endian header,
+metadata key-value table, tensor-info table, aligned data section) — the
+format llama.cpp writes and the reference parses via its vendored
+``gguf`` package (reference transformers/gguf/gguf.py).  Independent
+implementation from the spec; no code ported.
+"""
+
+from __future__ import annotations
+
+import mmap
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+GGUF_MAGIC = 0x46554747  # "GGUF" little-endian
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32 = 0, 1, 2, 3, 4, 5
+_T_F32, _T_BOOL, _T_STR, _T_ARR, _T_U64, _T_I64, _T_F64 = 6, 7, 8, 9, 10, 11, 12
+
+_SCALAR_FMT = {
+    _T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+    _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+    _T_I64: "<q", _T_F64: "<d",
+}
+
+#: ggml tensor-type id -> (block_elems, block_bytes); float types use 1 elem
+GGML_TYPE_LAYOUT = {
+    0: (1, 4),      # F32
+    1: (1, 2),      # F16
+    2: (32, 18),    # Q4_0: fp16 d + 16B nibbles
+    3: (32, 20),    # Q4_1: fp16 d, fp16 m + 16B nibbles
+    6: (32, 22),    # Q5_0: fp16 d + 4B high bits + 16B nibbles
+    7: (32, 24),    # Q5_1: fp16 d, fp16 m + 4B + 16B
+    8: (32, 34),    # Q8_0: fp16 d + 32 int8
+    10: (256, 84),   # Q2_K
+    11: (256, 110),  # Q3_K
+    12: (256, 144),  # Q4_K
+    13: (256, 176),  # Q5_K
+    14: (256, 210),  # Q6_K
+    15: (256, 292),  # Q8_K
+    30: (1, 2),     # BF16
+}
+
+GGML_TYPE_NAME = {
+    0: "fp32", 1: "fp16", 2: "q4_0", 3: "q4_1", 6: "q5_0", 7: "q5_1",
+    8: "q8_0", 10: "q2_k", 11: "q3_k", 12: "q4_k", 13: "q5_k", 14: "q6_k",
+    15: "q8_k", 30: "bf16",
+}
+
+
+@dataclass(frozen=True)
+class TensorInfo:
+    name: str
+    shape: tuple[int, ...]   # logical shape, numpy order [out, in] for 2-D
+    ggml_type: int
+    offset: int              # relative to data section start
+    nbytes: int
+
+
+class GGUFReader:
+    """Parse header + metadata eagerly; read tensor bytes lazily via mmap."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = open(path, "rb")
+        self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        self._pos = 0
+
+        magic, version = self._unpack("<II")
+        if magic != GGUF_MAGIC:
+            raise ValueError(f"{path!r} is not a GGUF file (magic {magic:#x})")
+        if version not in (2, 3):
+            raise ValueError(f"unsupported GGUF version {version}")
+        self.version = version
+        n_tensors, n_kv = self._unpack("<QQ")
+
+        self.metadata: dict[str, object] = {}
+        for _ in range(n_kv):
+            key = self._read_str()
+            (vtype,) = self._unpack("<I")
+            self.metadata[key] = self._read_value(vtype)
+
+        self.tensors: dict[str, TensorInfo] = {}
+        infos = []
+        for _ in range(n_tensors):
+            name = self._read_str()
+            (n_dims,) = self._unpack("<I")
+            dims = self._unpack("<" + "Q" * n_dims)
+            (ggml_type,) = self._unpack("<I")
+            (offset,) = self._unpack("<Q")
+            if ggml_type not in GGML_TYPE_LAYOUT:
+                raise NotImplementedError(
+                    f"tensor {name!r}: unsupported ggml type {ggml_type}"
+                )
+            be, bb = GGML_TYPE_LAYOUT[ggml_type]
+            n_elems = int(np.prod(dims)) if dims else 1
+            nbytes = n_elems // be * bb
+            # GGUF dims are innermost-first; numpy shape is the reverse
+            shape = tuple(int(d) for d in reversed(dims))
+            infos.append(TensorInfo(name, shape, ggml_type, offset, nbytes))
+        alignment = int(self.metadata.get("general.alignment", 32))
+        self._data_start = (self._pos + alignment - 1) // alignment * alignment
+        self.tensors = {t.name: t for t in infos}
+
+    # -- low-level ----------------------------------------------------------
+
+    def _unpack(self, fmt: str):
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, self._mm, self._pos)
+        self._pos += size
+        return vals
+
+    def _read_str(self) -> str:
+        (n,) = self._unpack("<Q")
+        s = self._mm[self._pos : self._pos + n].decode("utf-8", errors="replace")
+        self._pos += n
+        return s
+
+    def _read_value(self, vtype: int):
+        if vtype == _T_STR:
+            return self._read_str()
+        if vtype == _T_BOOL:
+            (v,) = self._unpack("<B")
+            return bool(v)
+        if vtype == _T_ARR:
+            (etype,) = self._unpack("<I")
+            (n,) = self._unpack("<Q")
+            if etype in _SCALAR_FMT and etype != _T_STR:
+                fmt = _SCALAR_FMT[etype]
+                itemsize = struct.calcsize(fmt)
+                arr = np.frombuffer(
+                    self._mm, dtype=np.dtype(fmt[1:]).newbyteorder("<"),
+                    count=n, offset=self._pos,
+                )
+                self._pos += n * itemsize
+                return arr
+            return [self._read_value(etype) for _ in range(n)]
+        (v,) = self._unpack(_SCALAR_FMT[vtype])
+        return v
+
+    # -- tensor access ------------------------------------------------------
+
+    def names(self) -> list[str]:
+        return list(self.tensors)
+
+    def raw(self, name: str) -> np.ndarray:
+        """Raw tensor bytes as uint8 [nbytes] (zero-copy view of the mmap)."""
+        t = self.tensors[name]
+        start = self._data_start + t.offset
+        return np.frombuffer(self._mm, np.uint8, t.nbytes, start)
+
+    def astype_name(self, name: str) -> str:
+        return GGML_TYPE_NAME[self.tensors[name].ggml_type]
+
+    def close(self):
+        self._mm.close()
+        self._file.close()

@@ -72,7 +72,20 @@ class KVCache:
 
     def update_layer(self, kl: jnp.ndarray, vl: jnp.ndarray,
                      new_k: jnp.ndarray, new_v: jnp.ndarray, pos: jnp.ndarray):
-        """Write new_k/new_v [B, T, H, D] into layer slices at offset pos."""
+        """Write new_k/new_v [B, T, H, D] into layer slices at offset pos.
+
+        ``pos`` scalar: one uniform slot offset for the whole batch (the
+        generate loop's invariant).  ``pos`` [B]: per-row offsets (the
+        continuous-batching engine, where rows decode at different lengths).
+        """
+        if getattr(pos, "ndim", 0) == 1:
+            write = jax.vmap(
+                lambda buf, new, p: jax.lax.dynamic_update_slice(
+                    buf, new, (p, 0, 0)
+                )
+            )
+            return (write(kl, self.encode(new_k), pos),
+                    write(vl, self.encode(new_v), pos))
         kl = jax.lax.dynamic_update_slice(kl, self.encode(new_k), (0, pos, 0, 0))
         vl = jax.lax.dynamic_update_slice(vl, self.encode(new_v), (0, pos, 0, 0))
         return kl, vl

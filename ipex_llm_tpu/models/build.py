@@ -60,6 +60,7 @@ def build_params(
     lm_head_qtype: str | None = None,
     mixed_precision: bool = False,
     progress: Callable[[str], None] | None = None,
+    moe_scheme=None,
 ) -> dict[str, Any]:
     """Assemble the full decoder param pytree, quantizing as it streams.
 
@@ -114,6 +115,45 @@ def build_params(
         ob = get_opt(name(scheme.o, i, "bias"))
         if ob is not None:
             lp["o_bias"] = jnp.asarray(ob, jnp.float32)
+
+        # --- MoE block (mixtral/qwen-moe): per-expert QTensors stacked on a
+        # leading E axis, scanned (or ep-sharded) in the decoder
+        if cfg.layer_is_moe(i):
+            if moe_scheme is None:
+                raise ValueError(
+                    f"model has {cfg.num_experts} experts but the family "
+                    "declares no MoE weight scheme"
+                )
+            if cfg.moe_layer_start != 0:
+                raise NotImplementedError(
+                    "dense-prefix MoE models (deepseek-style) not supported yet"
+                )
+            rw = get(moe_scheme.router.format(i=i))          # [E, hidden]
+            lp["router"] = jnp.asarray(np.ascontiguousarray(rw.T), jnp.float32)
+            e_gu, e_down = [], []
+            for e in range(cfg.num_experts):
+                gw = get(moe_scheme.e_gate.format(i=i, e=e))
+                uw = get(moe_scheme.e_up.format(i=i, e=e))
+                dw = get(moe_scheme.e_down.format(i=i, e=e))
+                e_gu.append(quantize_weight(np.concatenate([gw, uw], 0), qtype))
+                e_down.append(quantize_weight(dw, qtype))
+            lp["moe_gate_up"] = stack_layer_trees(e_gu)
+            lp["moe_down"] = stack_layer_trees(e_down)
+            if moe_scheme.shared_gate is not None:
+                sg = get(moe_scheme.shared_gate.format(i=i))
+                su = get(moe_scheme.shared_up.format(i=i))
+                sd = get(moe_scheme.shared_down.format(i=i))
+                lp["shared_gate_up"] = quantize_weight(
+                    np.concatenate([sg, su], 0), qtype
+                )
+                lp["shared_down"] = quantize_weight(sd, qtype)
+                if moe_scheme.shared_router is not None:
+                    srw = get(moe_scheme.shared_router.format(i=i))  # [1, h]
+                    lp["shared_router"] = jnp.asarray(
+                        np.ascontiguousarray(srw.T), jnp.float32
+                    )
+            layers.append(lp)
+            continue
 
         # --- mlp (merged gate_up)
         if scheme.gate_up is not None:

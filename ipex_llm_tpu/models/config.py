@@ -64,6 +64,10 @@ class ModelConfig:
     moe_norm_topk_prob: bool = False
     moe_layer_start: int = 0        # deepseek: first k layers dense
     moe_router_scale: float = 1.0
+    # router order: True = softmax over ALL experts then top-k (qwen-moe,
+    # deepseek); False = top-k logits then softmax over the k (mixtral)
+    moe_softmax_before_topk: bool = True
+    moe_shared_expert_gate: bool = False  # qwen2-moe sigmoid shared gate
 
     def layer_is_sliding(self, layer_idx: int) -> bool:
         if self.layer_types is not None:

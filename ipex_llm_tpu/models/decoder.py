@@ -41,14 +41,25 @@ def _norm(x, w, cfg: ModelConfig, bias=None):
 
 
 def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
-                     q_slots, kv_len, kv_start, sliding, cache: KVCache):
+                     q_slots, kv_len, kv_start, sliding, cache: KVCache,
+                     collect_obs: int = 0):
     b, t, _ = x.shape
     h = _norm(x, lp["attn_norm"], cfg)
-    qkv = linear_ops.linear(h, lp["qkv"], lp.get("qkv_bias"))
     q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
-    q = qkv[..., :q_dim].reshape(b, t, cfg.num_heads, cfg.head_dim)
-    k = qkv[..., q_dim : q_dim + kv_dim].reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-    v = qkv[..., q_dim + kv_dim :].reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    if "qkv" in lp:
+        qkv = linear_ops.linear(h, lp["qkv"], lp.get("qkv_bias"))
+        q = qkv[..., :q_dim]
+        k = qkv[..., q_dim : q_dim + kv_dim]
+        v = qkv[..., q_dim + kv_dim :]
+    else:
+        # split projections (GGUF import keeps q/k/v in their native — and
+        # possibly different — block formats, e.g. q4_k q/k with q6_k v)
+        q = linear_ops.linear(h, lp["q"], lp.get("q_bias"))
+        k = linear_ops.linear(h, lp["k"], lp.get("k_bias"))
+        v = linear_ops.linear(h, lp["v"], lp.get("v_bias"))
+    q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
 
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_offset)
@@ -68,6 +79,8 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
                 [rope_ops.apply_rope(k[..., :rd], cos, sin, cfg.rope_layout), k[..., rd:]],
                 axis=-1,
             )
+
+    obs_q = q[:, -collect_obs:] if collect_obs else jnp.zeros((0,), x.dtype)
 
     kl, vl = cache.update_layer(kl, vl, k, v, slot0)
     kd = cache.decode_layer(kl, COMPUTE_DTYPE)
@@ -90,13 +103,73 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
     out = linear_ops.linear(attn, lp["o"], lp.get("o_bias"))
     if cfg.post_attn_norm:
         out = _norm(out, lp["post_attn_norm"], cfg)
-    return out, kl, vl
+    return out, kl, vl, obs_q
+
+
+def _moe_block(cfg: ModelConfig, lp: dict, x):
+    """Sparse-MoE FFN (mixtral/qwen-moe), reference deepseek.py:274-343 +
+    common.py:342-375 ``moe_group_topk``/``moe_forward_vec``.
+
+    TPU-native: router in fp32, then ONE ``lax.scan`` over the stacked
+    expert QTensors computing every expert on every token and accumulating
+    ``gate[e] * expert_e(h)`` — mask-based dispatch keeps shapes static (no
+    ragged gather); with an ``ep`` mesh axis the scan body's expert slice is
+    resident per-device and XLA psums the combine.
+    """
+    h = _norm(x, lp["mlp_norm"], cfg)
+    router_logits = jnp.matmul(
+        h.astype(jnp.float32), lp["router"]
+    )  # [B,T,E]
+    k = cfg.num_experts_per_tok
+    n_e = cfg.num_experts
+    if cfg.moe_softmax_before_topk:
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        w, idx = jax.lax.top_k(probs, k)
+        if cfg.moe_norm_topk_prob:
+            w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-20)
+    else:  # mixtral: top-k logits, softmax over the k
+        lg, idx = jax.lax.top_k(router_logits, k)
+        w = jax.nn.softmax(lg, axis=-1)
+    if cfg.moe_router_scale != 1.0:
+        w = w * cfg.moe_router_scale
+    # dense gate map [B,T,E]: zeros except the top-k columns
+    gates = (w[..., None] * jax.nn.one_hot(idx, n_e, dtype=w.dtype)).sum(-2)
+
+    def expert_step(acc, xs):
+        e_i, egu, edown = xs
+        gate, up = mlp_ops.split_gate_up(linear_ops.linear(h, egu))
+        y = linear_ops.linear(mlp_ops.gated_act_mul(gate, up, cfg.act), edown)
+        return acc + y * gates[..., e_i, None].astype(y.dtype), None
+
+    out, _ = jax.lax.scan(
+        expert_step,
+        jnp.zeros_like(x),
+        (jnp.arange(n_e), lp["moe_gate_up"], lp["moe_down"]),
+    )
+
+    if "shared_gate_up" in lp:  # qwen2-moe shared expert
+        gate, up = mlp_ops.split_gate_up(
+            linear_ops.linear(h, lp["shared_gate_up"])
+        )
+        ys = linear_ops.linear(mlp_ops.gated_act_mul(gate, up, cfg.act),
+                               lp["shared_down"])
+        if "shared_router" in lp:
+            g = jax.nn.sigmoid(
+                jnp.matmul(h.astype(jnp.float32), lp["shared_router"])
+            )
+            ys = ys * g.astype(ys.dtype)
+        out = out + ys
+    return out
 
 
 def _mlp_block(cfg: ModelConfig, lp: dict, x):
     h = _norm(x, lp["mlp_norm"], cfg)
-    gate_up = linear_ops.linear(h, lp["gate_up"], lp.get("gate_up_bias"))
-    gate, up = mlp_ops.split_gate_up(gate_up)
+    if "gate_up" in lp:
+        gate_up = linear_ops.linear(h, lp["gate_up"], lp.get("gate_up_bias"))
+        gate, up = mlp_ops.split_gate_up(gate_up)
+    else:
+        gate = linear_ops.linear(h, lp["gate"], lp.get("gate_bias"))
+        up = linear_ops.linear(h, lp["up"], lp.get("up_bias"))
     inner = mlp_ops.gated_act_mul(gate, up, cfg.act)
     out = linear_ops.linear(inner, lp["down"], lp.get("down_bias"))
     if cfg.post_mlp_norm:
@@ -112,10 +185,20 @@ def decoder_forward(
     rope_positions: jnp.ndarray,    # [B, T] logical positions (left-pad aware)
     kv_start: jnp.ndarray | None = None,  # [B] first valid cache slot
     last_token_only: bool = False,
-) -> tuple[jnp.ndarray, KVCache]:
+    collect_obs: int = 0,
+    slot_offsets: jnp.ndarray | None = None,  # [B] per-row cache write slots
+):
     """Run the decoder; returns (logits, updated cache).
 
     logits: [B, V] if last_token_only else [B, T, V].
+
+    ``collect_obs=W`` (static, prefill-only) additionally returns the last-W
+    post-RoPE queries of every layer ``[L, B, W, Hq, D]`` — the SnapKV
+    observation window used by compresskv.compress (reference kv.py:221).
+
+    ``slot_offsets`` [B] overrides the uniform ``cache.length`` write slot
+    with per-row offsets (continuous batching); the returned cache's
+    ``length`` is then left untouched — the caller tracks row lengths.
     """
     b, t = tokens.shape
     embed = params["embed"]
@@ -129,9 +212,14 @@ def decoder_forward(
             rope_positions, params["inv_freq"], params.get("rope_mscale", 1.0)
         )
 
-    slot0 = cache.length
-    q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
-    kv_len = jnp.broadcast_to(slot0 + t, (b,))
+    if slot_offsets is not None:
+        slot0 = slot_offsets                       # [B]
+        q_slots = slot0[:, None] + jnp.arange(t)[None, :]
+        kv_len = slot0 + t
+    else:
+        slot0 = cache.length
+        q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
+        kv_len = jnp.broadcast_to(slot0 + t, (b,))
 
     sliding_flags = jnp.array(
         [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
@@ -139,15 +227,16 @@ def decoder_forward(
 
     def body(x, xs):
         lp, kl, vl, sliding = xs
-        attn_out, kl, vl = _attention_block(
+        attn_out, kl, vl, obs_q = _attention_block(
             cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
-            sliding, cache,
+            sliding, cache, collect_obs,
         )
         x = x + attn_out
-        x = x + _mlp_block(cfg, lp, x)
-        return x, (kl, vl)
+        ffn = _moe_block if "moe_gate_up" in lp else _mlp_block
+        x = x + ffn(cfg, lp, x)
+        return x, (kl, vl, obs_q)
 
-    x, (k_new, v_new) = jax.lax.scan(
+    x, (k_new, v_new, obs_q) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v, sliding_flags)
     )
 
@@ -167,5 +256,8 @@ def decoder_forward(
     if cfg.logit_softcap is not None:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
 
-    new_cache = replace(cache, k=k_new, v=v_new, length=slot0 + t)
+    new_len = cache.length if slot_offsets is not None else slot0 + t
+    new_cache = replace(cache, k=k_new, v=v_new, length=new_len)
+    if collect_obs:
+        return logits.astype(jnp.float32), new_cache, obs_q
     return logits.astype(jnp.float32), new_cache

@@ -45,10 +45,25 @@ class WeightScheme:
 
 
 @dataclass(frozen=True)
+class MoEScheme:
+    """MoE weight-name templates ({i} = layer, {e} = expert)."""
+
+    router: str = "model.layers.{i}.mlp.gate.weight"
+    e_gate: str = "model.layers.{i}.mlp.experts.{e}.gate_proj.weight"
+    e_up: str = "model.layers.{i}.mlp.experts.{e}.up_proj.weight"
+    e_down: str = "model.layers.{i}.mlp.experts.{e}.down_proj.weight"
+    shared_gate: str | None = None
+    shared_up: str | None = None
+    shared_down: str | None = None
+    shared_router: str | None = None  # qwen2-moe shared_expert_gate
+
+
+@dataclass(frozen=True)
 class Family:
     name: str
     to_config: Callable[[dict], ModelConfig]
     scheme: WeightScheme = field(default_factory=WeightScheme)
+    moe: MoEScheme | None = None
 
 
 def _rope_from_hf(hf: dict, head_dim: int) -> RopeScaling:
@@ -160,6 +175,59 @@ _GEMMA2_SCHEME = WeightScheme(
     post_mlp_norm="model.layers.{i}.post_feedforward_layernorm.weight",
 )
 
+def _mixtral(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(
+        hf,
+        sliding_window=hf.get("sliding_window"),
+        num_experts=hf.get("num_local_experts", 8),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        moe_intermediate_size=hf["intermediate_size"],
+        moe_softmax_before_topk=False,   # HF Mixtral: top-k logits, softmax(k)
+        moe_norm_topk_prob=True,
+    ))
+
+
+def _qwen2_moe(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(
+        hf,
+        attention_bias=True,
+        num_experts=hf.get("num_experts", 60),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 4),
+        moe_intermediate_size=hf.get("moe_intermediate_size",
+                                     hf["intermediate_size"]),
+        num_shared_experts=1,
+        moe_shared_expert_gate=True,
+        moe_softmax_before_topk=True,
+        moe_norm_topk_prob=hf.get("norm_topk_prob", False),
+    ))
+
+
+def _qwen3_moe(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(
+        hf,
+        qk_norm=True,
+        num_experts=hf.get("num_experts", 128),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 8),
+        moe_intermediate_size=hf.get("moe_intermediate_size",
+                                     hf["intermediate_size"]),
+        moe_softmax_before_topk=True,
+        moe_norm_topk_prob=hf.get("norm_topk_prob", True),
+    ))
+
+
+_MIXTRAL_MOE = MoEScheme(
+    router="model.layers.{i}.block_sparse_moe.gate.weight",
+    e_gate="model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+    e_up="model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+    e_down="model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+)
+_QWEN2_MOE = MoEScheme(
+    shared_gate="model.layers.{i}.mlp.shared_expert.gate_proj.weight",
+    shared_up="model.layers.{i}.mlp.shared_expert.up_proj.weight",
+    shared_down="model.layers.{i}.mlp.shared_expert.down_proj.weight",
+    shared_router="model.layers.{i}.mlp.shared_expert_gate.weight",
+)
+
 FAMILIES: dict[str, Family] = {
     "llama": Family("llama", _llama),
     "mistral": Family("mistral", _mistral),
@@ -183,6 +251,17 @@ FAMILIES: dict[str, Family] = {
     ),
     "gemma": Family("gemma", _gemma, _GEMMA_SCHEME),
     "gemma2": Family("gemma2", _gemma2, _GEMMA2_SCHEME),
+    "mixtral": Family("mixtral", _mixtral, WeightScheme(), _MIXTRAL_MOE),
+    "qwen2_moe": Family("qwen2_moe", _qwen2_moe, WeightScheme(), _QWEN2_MOE),
+    "qwen3_moe": Family(
+        "qwen3_moe",
+        _qwen3_moe,
+        WeightScheme(
+            q_norm="model.layers.{i}.self_attn.q_norm.weight",
+            k_norm="model.layers.{i}.self_attn.k_norm.weight",
+        ),
+        MoEScheme(),
+    ),
 }
 
 

@@ -70,10 +70,32 @@ def _llama_tensor_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
     return shapes
 
 
+def _moe_tensor_shapes(cfg: ModelConfig) -> dict[str, tuple[int, ...]]:
+    """Extra tensors for a mixtral-scheme MoE model."""
+    h, fm, e = cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts
+    shapes: dict[str, tuple[int, ...]] = {}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}.block_sparse_moe."
+        shapes[p + "gate.weight"] = (e, h)
+        for j in range(e):
+            shapes[p + f"experts.{j}.w1.weight"] = (fm, h)
+            shapes[p + f"experts.{j}.w3.weight"] = (fm, h)
+            shapes[p + f"experts.{j}.w2.weight"] = (h, fm)
+    return shapes
+
+
 def random_params(cfg: ModelConfig, qtype: str = "sym_int4", seed: int = 0) -> dict:
-    """Random llama-scheme params built through ``build_params`` (streamed:
-    each tensor is generated on demand, never the whole checkpoint at once)."""
+    """Random params built through ``build_params`` (streamed: each tensor is
+    generated on demand, never the whole checkpoint at once).  MoE configs
+    (num_experts > 0) use the mixtral weight scheme."""
     shapes = _llama_tensor_shapes(cfg)
+    moe = cfg.num_experts > 0
+    if moe:
+        for i in range(cfg.num_layers):
+            p = f"model.layers.{i}.mlp."
+            for stem in ("gate_proj", "up_proj", "down_proj"):
+                del shapes[p + stem + ".weight"]
+        shapes.update(_moe_tensor_shapes(cfg))
     rng = np.random.default_rng(seed)
 
     def gen(name: str) -> np.ndarray:
@@ -85,5 +107,6 @@ def random_params(cfg: ModelConfig, qtype: str = "sym_int4", seed: int = 0) -> d
         scale = np.float32(0.3 / np.sqrt(max(s[-1], 1)) * 4)
         return rng.standard_normal(s, dtype=np.float32) * scale
 
-    fam = FAMILIES["llama"]
-    return build_params(cfg, fam.scheme, gen, lambda n: n in shapes, qtype=qtype)
+    fam = FAMILIES["mixtral" if moe else "llama"]
+    return build_params(cfg, fam.scheme, gen, lambda n: n in shapes,
+                        qtype=qtype, moe_scheme=fam.moe)

