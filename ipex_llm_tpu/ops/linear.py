@@ -51,11 +51,18 @@ def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndar
 
 
 def linear(x: jnp.ndarray, w, bias: jnp.ndarray | None = None) -> jnp.ndarray:
-    """General linear over either a QTensor or a plain array weight.
+    """General linear over a QTensor, a plain array, or a LoraWeight.
 
-    Reference counterpart: models/common.py:309 ``linear_forward``.
+    Reference counterpart: models/common.py:309 ``linear_forward`` and, for
+    the LoRA path, ``LoraLowBitLinear.forward`` (qlora.py:66): frozen base
+    matmul plus ``(x·A)·B · α/r`` with gradients flowing only through A/B.
     """
-    if isinstance(w, QTensor):
+    base = getattr(w, "base", None)
+    if base is not None:  # training.qlora.LoraWeight
+        y = linear(x, base)
+        lora = (x.astype(w.a.dtype) @ w.a) @ w.b * w.scale
+        y = y + lora.astype(y.dtype)
+    elif isinstance(w, QTensor):
         y = qmatmul(x, w)
     else:
         y = jnp.matmul(

@@ -49,7 +49,10 @@ def _top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
-def _top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+def _top_p_mask(logits: jnp.ndarray, p) -> jnp.ndarray:
+    """Nucleus mask; ``p`` is a scalar or a per-row [B] vector."""
+    if not isinstance(p, (int, float)):
+        p = jnp.asarray(p)[..., None]
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
@@ -59,6 +62,19 @@ def _top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     # (NEG_INF here would make the cutoff -inf and mask nothing)
     cutoff = jnp.where(cutoff_mask, jnp.inf, sorted_logits).min(axis=-1, keepdims=True)
     return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def sample_rows(logits: jnp.ndarray, temps: jnp.ndarray, top_ps: jnp.ndarray,
+                key: jax.Array) -> jnp.ndarray:
+    """Vectorized per-row sampling for the serving engine: rows with
+    temperature 0 take argmax, others sample from the temperature-scaled,
+    per-row-nucleus-masked distribution.  logits [R, V]; temps/top_ps [R]."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-4)[:, None]
+    scaled = _top_p_mask(scaled, top_ps)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
 
 
 def sample(

@@ -1,0 +1,13 @@
+"""Serving: OpenAI-compatible HTTP API over a continuous-batching engine.
+
+Reference counterparts: the FastAPI server (reference
+serving/fastapi/api_server.py:90, openai_protocol.py), the vLLM integration
+(vllm/, 4.5k LoC) and the PPModelWorker batch scheduler
+(pipeline_parallel.py:482-928).  TPU-native design: ONE static-shape jitted
+decode step over a fixed row pool; requests join/leave rows between steps
+(continuous batching) with per-row cache offsets instead of paged KV.
+"""
+
+from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
+
+__all__ = ["ServingEngine", "EngineConfig", "Request"]

@@ -1,0 +1,276 @@
+"""OpenAI-compatible HTTP server (aiohttp) over the ServingEngine.
+
+Reference counterpart: serving/fastapi/api_server.py:90 (+openai_protocol.py)
+— same endpoints (`/v1/chat/completions`, `/v1/completions`, `/v1/models`),
+same SSE streaming shape (``data: {chunk}\\n\\n`` … ``data: [DONE]``).
+FastAPI isn't available in this image; aiohttp.web provides the async server.
+
+Run: ``python -m ipex_llm_tpu.serving.api_server --model <dir> --port 8000``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Any
+
+try:
+    from aiohttp import web
+except ImportError as _e:  # pragma: no cover
+    web = None
+    _AIOHTTP_ERR = _e
+
+from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+class OpenAIServer:
+    def __init__(self, engine: ServingEngine, tokenizer, model_name: str):
+        if web is None:  # pragma: no cover
+            raise ImportError(f"aiohttp is required for serving: {_AIOHTTP_ERR}")
+        self.engine = engine
+        self.tok = tokenizer
+        self.model_name = model_name
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self.chat)
+        self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_get("/v1/models", self.models)
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/metrics", self.metrics)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _encode_chat(self, messages: list[dict]) -> list[int]:
+        if hasattr(self.tok, "apply_chat_template") and getattr(
+            self.tok, "chat_template", None
+        ):
+            return list(self.tok.apply_chat_template(
+                messages, add_generation_prompt=True
+            ))
+        text = "\n".join(f"{m['role']}: {m['content']}" for m in messages)
+        text += "\nassistant:"
+        return list(self.tok(text)["input_ids"])
+
+    def _mk_request(self, body: dict, prompt_ids: list[int]) -> Request:
+        def num(key, default, cast):
+            v = body.get(key)
+            return cast(default if v is None else v)
+
+        eos: tuple[int, ...] = ()
+        if self.tok.eos_token_id is not None:
+            eos = (int(self.tok.eos_token_id),)
+        req = Request(
+            prompt_ids=prompt_ids,
+            max_new_tokens=num("max_tokens", 128, int),
+            temperature=num("temperature", 0.0, float),
+            top_p=num("top_p", 1.0, float),
+            eos_token_id=eos,
+            request_id=str(uuid.uuid4()),
+        )
+        stop = body.get("stop")
+        req.stop_strings = ([stop] if isinstance(stop, str) else stop) or []
+        return req
+
+    @staticmethod
+    def _find_stop(text: str, stops: list[str]) -> int:
+        """Earliest stop-sequence offset in ``text``, or -1."""
+        hits = [text.find(s) for s in stops if s and text.find(s) >= 0]
+        return min(hits) if hits else -1
+
+    async def _collect(self, req: Request) -> str:
+        loop = asyncio.get_running_loop()
+        toks: list[int] = []
+        drop = set(req.eos_token_id)
+        stops = getattr(req, "stop_strings", [])
+        while True:
+            tok = await loop.run_in_executor(None, req.stream_queue.get)
+            if tok is None:
+                break
+            if tok in drop:
+                continue
+            toks.append(tok)
+            if stops:
+                text = self.tok.decode(toks)
+                cut = self._find_stop(text, stops)
+                if cut >= 0:
+                    self.engine.abort(req)
+                    req.finish_reason = "stop"
+                    return text[:cut]
+        return self.tok.decode(toks)
+
+    async def _stream_sse(self, request, req: Request, chunk_fn):
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        drop = set(req.eos_token_id)
+        stops = getattr(req, "stop_strings", [])
+        sent = ""
+        toks: list[int] = []
+        try:
+            while True:
+                tok = await loop.run_in_executor(None, req.stream_queue.get)
+                if tok is None:
+                    break
+                if tok in drop:
+                    continue
+                toks.append(tok)
+                text = self.tok.decode(toks)
+                cut = self._find_stop(text, stops) if stops else -1
+                if cut >= 0:
+                    piece, done = text[:cut][len(sent):], True
+                else:
+                    piece, done = text[len(sent):], False
+                if piece:
+                    sent += piece
+                    await resp.write(
+                        f"data: {json.dumps(chunk_fn(piece, None))}\n\n".encode()
+                    )
+                if done:
+                    self.engine.abort(req)
+                    req.finish_reason = "stop"
+                    break
+            await resp.write(
+                f"data: {json.dumps(chunk_fn('', req.finish_reason))}\n\n".encode()
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: free the engine row instead of decoding on
+            self.engine.abort(req)
+            raise
+        return resp
+
+    # -- endpoints ----------------------------------------------------------
+
+    async def chat(self, request):
+        body = await request.json()
+        ids = self._encode_chat(body.get("messages", []))
+        req = self.engine.submit(self._mk_request(body, ids))
+        rid = f"chatcmpl-{req.request_id[:12]}"
+
+        if body.get("stream"):
+            def chunk(piece: str, finish):
+                delta = {"content": piece} if piece else {}
+                return {
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": _now(), "model": self.model_name,
+                    "choices": [{"index": 0, "delta": delta,
+                                 "finish_reason": finish}],
+                }
+            return await self._stream_sse(request, req, chunk)
+
+        text = await self._collect(req)
+        return web.json_response({
+            "id": rid, "object": "chat.completion", "created": _now(),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(req.prompt_ids),
+                "completion_tokens": len(req.output_ids),
+                "total_tokens": len(req.prompt_ids) + len(req.output_ids),
+            },
+        })
+
+    async def completions(self, request):
+        body = await request.json()
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0]
+        ids = list(self.tok(prompt)["input_ids"])
+        req = self.engine.submit(self._mk_request(body, ids))
+        rid = f"cmpl-{req.request_id[:12]}"
+
+        if body.get("stream"):
+            def chunk(piece: str, finish):
+                return {
+                    "id": rid, "object": "text_completion", "created": _now(),
+                    "model": self.model_name,
+                    "choices": [{"index": 0, "text": piece,
+                                 "finish_reason": finish}],
+                }
+            return await self._stream_sse(request, req, chunk)
+
+        text = await self._collect(req)
+        return web.json_response({
+            "id": rid, "object": "text_completion", "created": _now(),
+            "model": self.model_name,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": req.finish_reason}],
+            "usage": {
+                "prompt_tokens": len(req.prompt_ids),
+                "completion_tokens": len(req.output_ids),
+                "total_tokens": len(req.prompt_ids) + len(req.output_ids),
+            },
+        })
+
+    async def models(self, request):
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model_name, "object": "model",
+                      "owned_by": "ipex_llm_tpu"}],
+        })
+
+    async def health(self, request):
+        return web.json_response({"status": "ok"})
+
+    async def metrics(self, request):
+        return web.json_response(dict(self.engine.metrics))
+
+
+def build_server(model_path: str, low_bit: str = "sym_int4",
+                 engine_config: EngineConfig | None = None,
+                 model=None, tokenizer=None) -> OpenAIServer:
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    if model is None:
+        import os
+
+        if os.path.exists(f"{model_path}/bigdl_config.json"):
+            model = AutoModelForCausalLM.load_low_bit(model_path)
+        else:
+            model = AutoModelForCausalLM.from_pretrained(
+                model_path, load_in_low_bit=low_bit
+            )
+    if tokenizer is None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(model_path,
+                                                  trust_remote_code=True)
+    engine = ServingEngine(
+        model.config, model.params, engine_config,
+        default_eos=model.generation_config.eos_token_id,
+    ).start()
+    return OpenAIServer(engine, tokenizer, model_name=model_path)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("ipex-llm-tpu OpenAI-compatible server")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-rows", type=int, default=4)
+    ap.add_argument("--max-seq-len", type=int, default=2048)
+    args = ap.parse_args(argv)
+    srv = build_server(
+        args.model, args.low_bit,
+        EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len),
+    )
+    web.run_app(srv.app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,314 @@
+"""Continuous-batching decode engine (the vLLM-equivalent core).
+
+Design (TPU-first; contrast reference vllm/ + PPModelWorker
+pipeline_parallel.py:482-928 which rely on vLLM's paged attention):
+
+- a fixed pool of ``max_rows`` sequence rows sharing one static KV buffer
+  ``[L, R, S_max, H, D]`` — static shapes mean the decode step compiles
+  exactly once;
+- every step decodes ALL rows in one jitted call; inactive rows are masked
+  (their sampled token is ignored), so join/leave never recompiles;
+- a new request prefills on the bucketed single-row program (reusing
+  generation.prefill_step) and its KV slice is copied into a free row
+  between steps — prefill never blocks other rows' decode for more than one
+  step boundary;
+- per-row temperature/top-p live as traced vectors, so heterogeneous
+  sampling params ride the same program.
+
+The engine thread owns the device; asyncio handlers talk to it through
+queues (reference fastapi server uses the same queue pattern,
+api_server.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.generation import _round_up, prefill_step
+from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.decoder import decoder_forward
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    max_rows: int = 4           # concurrent sequences
+    max_seq_len: int = 2048     # per-row KV capacity
+    prefill_bucket: int = 128
+
+
+@dataclass
+class Request:
+    prompt_ids: list[int]
+    max_new_tokens: int = 128
+    temperature: float = 0.0    # 0 = greedy
+    top_p: float = 1.0
+    eos_token_id: tuple[int, ...] = ()
+    stream_queue: "queue.Queue[int | None]" = field(default_factory=queue.Queue)
+    request_id: str = ""
+    # filled by the engine
+    output_ids: list[int] = field(default_factory=list)
+    finish_reason: str | None = None
+    first_token_s: float = 0.0
+    submitted_s: float = field(default_factory=time.perf_counter)
+    cancelled: bool = False  # set via ServingEngine.abort (client disconnect)
+    stop_strings: list[str] = field(default_factory=list)
+
+    def abort(self):
+        self.cancelled = True
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
+                 temps, top_ps, key):
+    """One batched decode step over the whole row pool.
+
+    toks [R] current token per row; row_lens [R] tokens already in cache.
+    Returns (next_tokens [R], cache, key).
+    """
+    from ipex_llm_tpu.ops.sampling import sample_rows
+
+    logits, cache = decoder_forward(
+        cfg, params, toks[:, None], cache, row_lens[:, None],
+        last_token_only=True, slot_offsets=row_lens,
+    )
+    key, sub = jax.random.split(key)
+    nxt = sample_rows(logits, temps, top_ps, sub)
+    nxt = jnp.where(active, nxt, 0)
+    return nxt, cache, key
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _insert_row(cache: KVCache, prefill_cache: KVCache, n_valid, row):
+    """Copy a prefilled single-row cache (left-padded) into pool row ``row``
+    at slot 0."""
+    # valid slots of the prefill cache are [tpad - n, tpad); shift to 0
+    tpad = prefill_cache.k.shape[2]
+    start = tpad - n_valid
+
+    def per_layer_copy(pool_buf, pre_buf):
+        # pool_buf [L,R,S,H,D]; pre_buf [L,1,Tpad,H,D]
+        src = jnp.roll(pre_buf[:, 0], -start, axis=1)       # valid now at 0
+        src = src[:, : pool_buf.shape[2]]                   # clip to S_max
+        pad = pool_buf.shape[2] - src.shape[1]
+        if pad > 0:
+            src = jnp.pad(src, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return pool_buf.at[:, row].set(src.astype(pool_buf.dtype))
+
+    return KVCache(
+        k=per_layer_copy(cache.k, prefill_cache.k),
+        v=per_layer_copy(cache.v, prefill_cache.v),
+        length=cache.length,
+        storage=cache.storage,
+    )
+
+
+class ServingEngine:
+    """Threaded continuous-batching engine around one model."""
+
+    def __init__(self, cfg: ModelConfig, params: dict,
+                 engine_config: EngineConfig | None = None,
+                 default_eos: tuple[int, ...] = ()):
+        self.cfg = cfg
+        self.params = params
+        self.ec = engine_config or EngineConfig()
+        self.default_eos = default_eos
+        r, s = self.ec.max_rows, self.ec.max_seq_len
+        self.cache = KVCache.init(cfg.num_layers, r, s, cfg.num_kv_heads,
+                                  cfg.head_dim)
+        self.rows: list[Request | None] = [None] * r
+        self.row_lens = np.zeros((r,), np.int32)
+        self.row_budget = np.zeros((r,), np.int32)
+        self.toks = np.zeros((r,), np.int32)
+        self.temps = np.zeros((r,), np.float32)
+        self.top_ps = np.ones((r,), np.float32)
+        self.key = jax.random.PRNGKey(0)
+        self._inbox: "queue.Queue[Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.metrics = {"requests": 0, "tokens": 0, "steps": 0}
+
+    # -- public API ---------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+
+    def submit(self, req: Request) -> Request:
+        if not req.eos_token_id:
+            req.eos_token_id = self.default_eos
+        self._inbox.put(req)
+        return req
+
+    # -- engine loop --------------------------------------------------------
+
+    def _free_row(self) -> int | None:
+        for i, r in enumerate(self.rows):
+            if r is None:
+                return i
+        return None
+
+    def abort(self, req: Request):
+        """Cancel a request (e.g. client disconnect); its row frees at the
+        next step boundary."""
+        req.cancelled = True
+
+    def _admit(self, max_joins: int = 1):
+        """Join pending requests into free rows (between decode steps).
+
+        At most ``max_joins`` per step boundary while other rows decode, so
+        a burst of prefills can't stall in-flight streams for more than one
+        prefill forward per emitted token.
+        """
+        joined = 0
+        while joined < max_joins:
+            row = self._free_row()
+            if row is None:
+                return
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancelled:
+                req.finish_reason = "abort"
+                req.stream_queue.put(None)
+                continue
+            joined += 1
+            prompt = np.asarray(req.prompt_ids, np.int32)
+            n_p = len(prompt)
+            if n_p + req.max_new_tokens > self.ec.max_seq_len:
+                req.finish_reason = "length"
+                req.stream_queue.put(None)
+                continue
+            tpad = _round_up(max(n_p, 1), self.ec.prefill_bucket)
+            toks = np.zeros((1, tpad), np.int32)
+            toks[0, tpad - n_p:] = prompt
+            pre_cache = KVCache.init(
+                self.cfg.num_layers, 1, tpad, self.cfg.num_kv_heads,
+                self.cfg.head_dim,
+            )
+            logits, pre_cache = prefill_step(
+                self.cfg, self.params, pre_cache, jnp.asarray(toks),
+                jnp.asarray([n_p], np.int32),
+            )
+            self.cache = _insert_row(
+                self.cache, pre_cache, jnp.asarray(n_p, jnp.int32),
+                jnp.asarray(row, jnp.int32),
+            )
+            from ipex_llm_tpu.ops.sampling import sample_rows
+
+            self.key, sub = jax.random.split(self.key)
+            first = int(np.asarray(sample_rows(
+                logits, jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_p], jnp.float32), sub,
+            ))[0])
+            req.first_token_s = time.perf_counter() - req.submitted_s
+            self.rows[row] = req
+            self.row_lens[row] = n_p
+            self.row_budget[row] = req.max_new_tokens
+            self.toks[row] = first
+            self.temps[row] = req.temperature
+            self.top_ps[row] = req.top_p
+            self.metrics["requests"] += 1
+            self._emit(row, first)
+
+    def _emit(self, row: int, token: int):
+        req = self.rows[row]
+        if req.cancelled:
+            self._finish(row, "abort")
+            return
+        req.output_ids.append(token)
+        req.stream_queue.put(token)
+        self.metrics["tokens"] += 1
+        if token in req.eos_token_id:
+            self._finish(row, "stop")
+        elif len(req.output_ids) >= self.row_budget[row]:
+            self._finish(row, "length")
+
+    def _finish(self, row: int, reason: str):
+        req = self.rows[row]
+        req.finish_reason = reason
+        req.stream_queue.put(None)
+        self.rows[row] = None
+        self.row_lens[row] = 0
+        self.toks[row] = 0
+
+    def _fail_all(self, exc: BaseException):
+        """Engine-level failure: finish every in-flight/queued request so no
+        client blocks forever, then keep serving."""
+        for i, req in enumerate(self.rows):
+            if req is not None:
+                self._finish(i, "error")
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            req.finish_reason = "error"
+            req.stream_queue.put(None)
+        self.metrics["errors"] = self.metrics.get("errors", 0) + 1
+        self.metrics["last_error"] = f"{type(exc).__name__}: {exc}"
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self._step_once()
+            except Exception as exc:  # keep the serving thread alive
+                self._fail_all(exc)
+
+    def _step_once(self):
+        self._admit()
+        for i, req in enumerate(self.rows):  # drop disconnected clients
+            if req is not None and req.cancelled:
+                self._finish(i, "abort")
+        active = np.array([r is not None for r in self.rows])
+        if not active.any():
+            try:
+                req = self._inbox.get(timeout=0.02)
+                self._inbox.put(req)
+            except queue.Empty:
+                pass
+            return
+        # KV write for the current token happens inside the step; the
+        # token at row_lens gets slot row_lens
+        nxt, self.cache, self.key = _decode_step(
+            self.cfg, self.params, self.cache,
+            jnp.asarray(self.toks), jnp.asarray(self.row_lens),
+            jnp.asarray(active), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ps), self.key,
+        )
+        nxt = np.asarray(nxt)
+        self.metrics["steps"] += 1
+        for i in range(len(self.rows)):
+            if not active[i] or self.rows[i] is None:
+                continue
+            self.row_lens[i] += 1
+            tok = int(nxt[i])
+            self.toks[i] = tok
+            self._emit(i, tok)
+
+
+def stream_tokens(req: Request, timeout: float = 120.0):
+    """Yield tokens from a submitted request until completion."""
+    while True:
+        tok = req.stream_queue.get(timeout=timeout)
+        if tok is None:
+            return
+        yield tok

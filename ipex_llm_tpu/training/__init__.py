@@ -11,5 +11,22 @@ from ipex_llm_tpu.training.step import (
     causal_lm_loss,
     make_train_step,
 )
+from ipex_llm_tpu.training.qlora import (
+    LoraConfig,
+    LoraWeight,
+    attach_lora,
+    get_peft_model,
+    init_lora,
+    make_qlora_train_step,
+    merge_lora,
+)
+from ipex_llm_tpu.training.relora import ReLoRATrainer, jagged_cosine_schedule
+from ipex_llm_tpu.training.lisa import LisaTrainer, make_lisa_train_step
 
-__all__ = ["causal_lm_loss", "make_train_step"]
+__all__ = [
+    "causal_lm_loss", "make_train_step",
+    "LoraConfig", "LoraWeight", "attach_lora", "get_peft_model",
+    "init_lora", "make_qlora_train_step", "merge_lora",
+    "ReLoRATrainer", "jagged_cosine_schedule",
+    "LisaTrainer", "make_lisa_train_step",
+]

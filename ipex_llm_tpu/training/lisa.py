@@ -1,0 +1,95 @@
+"""LISA: layerwise importance sampling — train a random layer subset.
+
+Reference counterpart: ``DynamicLayerActivationCallback`` (reference
+lisa.py:23): every ``interval`` steps freeze all decoder layers, then
+unfreeze ``n_layers`` randomly chosen ones (embed/head stay trainable).
+
+TPU-native: our layers are ONE stacked pytree ``[L, ...]``, so
+(un)freezing is a gradient mask over the leading axis — no module
+iteration, and the jitted train step never recompiles when the active set
+changes (the mask is a traced input).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.models.config import ModelConfig
+
+
+def sample_active_layers(key: jax.Array, num_layers: int,
+                         n_active: int) -> jnp.ndarray:
+    """Boolean mask [L] with exactly ``n_active`` True entries."""
+    perm = jax.random.permutation(key, num_layers)
+    return jnp.zeros((num_layers,), bool).at[perm[:n_active]].set(True)
+
+
+def mask_layer_grads(grads: dict, layer_mask: jnp.ndarray) -> dict:
+    """Zero gradients of frozen layers; embed/head/final_norm untouched
+    (the reference always keeps embedding + lm_head active, lisa.py:32)."""
+
+    def mask_leaf(g):
+        if getattr(g, "ndim", 0) >= 1 and g.shape[0] == layer_mask.shape[0]:
+            shape = (-1,) + (1,) * (g.ndim - 1)
+            return g * layer_mask.reshape(shape).astype(g.dtype)
+        return g
+
+    out = dict(grads)
+    out["layers"] = jax.tree_util.tree_map(mask_leaf, grads["layers"])
+    return out
+
+
+def make_lisa_train_step(cfg: ModelConfig, optimizer, loss_fn=None):
+    """Jitted ``step(params, opt_state, tokens, layer_mask)``."""
+    import optax
+
+    from ipex_llm_tpu.training.step import causal_lm_loss
+
+    loss_fn = loss_fn or causal_lm_loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, layer_mask):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=1)(cfg, params,
+                                                             tokens)
+        grads = mask_layer_grads(grads, layer_mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+class LisaTrainer:
+    """Step-driven trainer resampling the active layer set every interval
+    (reference lisa.py:23 ``DynamicLayerActivationCallback``)."""
+
+    def __init__(self, model, optimizer, n_active_layers: int = 2,
+                 interval: int = 20, seed: int = 0):
+        self.model = model
+        self.optimizer = optimizer
+        self.n_active = n_active_layers
+        self.interval = interval
+        self.key = jax.random.PRNGKey(seed)
+        self.opt_state = optimizer.init(model.params)
+        self._step_fn = make_lisa_train_step(model.config, optimizer)
+        self.step_count = 0
+        self._resample()
+
+    def _resample(self):
+        self.key, sub = jax.random.split(self.key)
+        self.layer_mask = sample_active_layers(
+            sub, self.model.config.num_layers, self.n_active
+        )
+
+    def step(self, tokens) -> float:
+        if self.step_count and self.step_count % self.interval == 0:
+            self._resample()
+        self.model.params, self.opt_state, loss = self._step_fn(
+            self.model.params, self.opt_state, jnp.asarray(tokens),
+            self.layer_mask,
+        )
+        self.step_count += 1
+        return float(loss)

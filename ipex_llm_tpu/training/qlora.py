@@ -1,0 +1,213 @@
+"""QLoRA: LoRA adapters over a frozen block-quantized base.
+
+Reference counterpart: ``LoraLowBitLinear`` (reference qlora.py:66 — LoRA on
+an NF4/INT4 base whose backward dequantizes the base,
+low_bit_linear.py:552-573 ``MatMulLowBit.backward``) and the patched
+``get_peft_model``/``LoraConfig`` (qlora.py:254-352).
+
+TPU-native design: no module patching — a ``LoraWeight`` pytree node wraps
+the frozen QTensor with the (A, B) adapters, and ``ops.linear`` applies
+``y = base(x) + (x·A)·B · α/r``.  The base stays packed; autodiff through
+the dequant-matmul gives exactly the straight-through dequant gradient the
+reference implements by hand, but only the adapter leaves are optimizer
+targets, so the train step's grad pytree is just the adapters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.models.config import ModelConfig
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    """Reference qlora.py:254 ``LoraConfig`` equivalent."""
+
+    r: int = 8
+    lora_alpha: int = 16
+    target_modules: tuple[str, ...] = ("qkv", "o", "gate_up", "down")
+    lora_dropout: float = 0.0  # applied by the caller's data pipeline
+    train_embeddings: bool = False
+
+    @property
+    def scale(self) -> float:
+        return self.lora_alpha / self.r
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LoraWeight:
+    """Frozen base weight + trainable LoRA adapters (a pytree node)."""
+
+    base: Any               # QTensor or dense array, frozen
+    a: jnp.ndarray          # [..., in, r]
+    b: jnp.ndarray          # [..., r, out]
+    scale: float = 1.0      # static aux
+
+    def tree_flatten(self):
+        return (self.base, self.a, self.b), (self.scale,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        base, a, b = children
+        return cls(base, a, b, scale=aux[0])
+
+
+def _slot_dims(qt) -> tuple[int, int]:
+    from ipex_llm_tpu.quantize.core import QTensor
+
+    if isinstance(qt, QTensor):
+        return qt.in_features, qt.out_features
+    return qt.shape[-2], qt.shape[-1]
+
+
+def init_lora(
+    key: jax.Array,
+    cfg: ModelConfig,
+    params: dict,
+    lora_cfg: LoraConfig,
+    dtype=jnp.float32,
+) -> dict:
+    """Build the trainable adapter pytree: {slot: {"a": [L,in,r], "b": [L,r,out]}}.
+
+    A ~ N(0, 1/r) (kaiming-ish), B = 0 — so the merged model starts exactly
+    equal to the base (reference peft init).
+    """
+    adapters: dict[str, dict[str, jnp.ndarray]] = {}
+    n_l = cfg.num_layers
+    for slot in lora_cfg.target_modules:
+        if slot not in params["layers"]:
+            continue
+        d_in, d_out = _slot_dims(params["layers"][slot])
+        key, sub = jax.random.split(key)
+        adapters[slot] = {
+            "a": (jax.random.normal(sub, (n_l, d_in, lora_cfg.r), dtype)
+                  / jnp.sqrt(lora_cfg.r)),
+            "b": jnp.zeros((n_l, lora_cfg.r, d_out), dtype),
+        }
+    return adapters
+
+
+def attach_lora(params: dict, adapters: dict, lora_cfg: LoraConfig) -> dict:
+    """Wrap target slots with LoraWeight (pure; base leaves are shared)."""
+    layers = dict(params["layers"])
+    for slot, ab in adapters.items():
+        layers[slot] = LoraWeight(
+            base=params["layers"][slot], a=ab["a"], b=ab["b"],
+            scale=lora_cfg.scale,
+        )
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def merge_lora(params: dict, adapters: dict, lora_cfg: LoraConfig) -> dict:
+    """Fold adapters into the base weights (dequant → add → requantize).
+
+    Reference counterpart: peft merge / ReLoRA's merge-and-reset
+    (relora.py:383-455).  Quantized slots are requantized to their own
+    qtype; dense slots are added in place.
+    """
+    import numpy as np
+
+    from ipex_llm_tpu.quantize import core as qcore
+    from ipex_llm_tpu.quantize.core import QTensor
+
+    layers = dict(params["layers"])
+    for slot, ab in adapters.items():
+        base = layers[slot]
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * lora_cfg.scale
+        if isinstance(base, QTensor):
+            merged = []
+            n_l = delta.shape[0]
+            for i in range(n_l):
+                qt_i = jax.tree_util.tree_map(lambda x: x[i], base)
+                w = qcore.dequantize(qt_i) + delta[i]
+                merged.append(qcore.quantize(np.asarray(w), base.qtype,
+                                             base.block_size or None))
+            layers[slot] = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *merged
+            )
+        else:
+            layers[slot] = (base.astype(jnp.float32) + delta).astype(base.dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def make_qlora_train_step(cfg: ModelConfig, optimizer, lora_cfg: LoraConfig,
+                          loss_fn=None):
+    """Jitted ``step(adapters, opt_state, tokens, base_params)``.
+
+    Gradients flow ONLY into the adapter pytree; the quantized base rides
+    along as a closed-over constant input (frozen by construction, the
+    ``requires_grad=False`` of the reference's prepare_model_for_kbit_training).
+    """
+    import optax
+
+    from ipex_llm_tpu.training.step import causal_lm_loss
+
+    loss_fn = loss_fn or causal_lm_loss
+
+    def lora_loss(adapters, tokens, base_params):
+        p = attach_lora(base_params, adapters, lora_cfg)
+        return loss_fn(cfg, p, tokens)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(adapters, opt_state, tokens, base_params):
+        loss, grads = jax.value_and_grad(lora_loss)(adapters, tokens,
+                                                    base_params)
+        updates, opt_state = optimizer.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return adapters, opt_state, loss
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# model-level convenience (the reference get_peft_model shape)
+# ---------------------------------------------------------------------------
+
+
+class PeftModel:
+    """Thin trainable wrapper (reference qlora.py:254 ``get_peft_model``)."""
+
+    def __init__(self, model, lora_cfg: LoraConfig, seed: int = 0):
+        self.model = model
+        self.lora_cfg = lora_cfg
+        self.adapters = init_lora(
+            jax.random.PRNGKey(seed), model.config, model.params, lora_cfg
+        )
+        self._step = None
+        self._opt_state = None
+        self._optimizer = None
+
+    def compile(self, optimizer):
+        self._optimizer = optimizer
+        self._opt_state = optimizer.init(self.adapters)
+        self._step = make_qlora_train_step(
+            self.model.config, optimizer, self.lora_cfg
+        )
+        return self
+
+    def train_step(self, tokens) -> float:
+        self.adapters, self._opt_state, loss = self._step(
+            self.adapters, self._opt_state, jnp.asarray(tokens),
+            self.model.params,
+        )
+        return float(loss)
+
+    def merge_and_unload(self):
+        self.model.params = merge_lora(self.model.params, self.adapters,
+                                       self.lora_cfg)
+        return self.model
+
+
+def get_peft_model(model, lora_cfg: LoraConfig, seed: int = 0) -> PeftModel:
+    return PeftModel(model, lora_cfg, seed)

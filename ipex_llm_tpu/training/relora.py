@@ -1,0 +1,90 @@
+"""ReLoRA: periodic merge-and-reset of LoRA adapters into the base.
+
+Reference counterpart: ``ReLoRATrainer``/``ReLoRACallback``/``ReLoRAScheduler``
+(reference relora.py:64,149,286): every ``relora_steps`` the adapters are
+merged into the base weights, re-initialized, the optimizer state for the
+adapters is (mostly) zeroed, and the LR follows a jagged-cosine restart
+schedule.  Functional TPU version: the trainer object owns no modules —
+merge/reset are pure pytree transforms.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.training.qlora import (
+    LoraConfig,
+    init_lora,
+    make_qlora_train_step,
+    merge_lora,
+)
+
+
+def jagged_cosine_schedule(base_lr: float, total_steps: int,
+                           restart_every: int, warmup: int = 10,
+                           min_ratio: float = 0.1):
+    """Reference ReLoRAScheduler (relora.py:286): cosine with hard restarts,
+    each restart preceded by a short linear re-warmup."""
+
+    def lr(step):
+        step = jnp.asarray(step)
+        in_cycle = step % restart_every
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * step / max(total_steps, 1)))
+        scale = min_ratio + (1 - min_ratio) * cos
+        rewarm = jnp.where(
+            step < restart_every,  # first cycle has no restart warmup
+            1.0,
+            jnp.minimum(1.0, in_cycle / max(warmup, 1)),
+        )
+        return base_lr * scale * rewarm
+
+    return lr
+
+
+@dataclass
+class ReLoRATrainer:
+    """Minimal step-driven trainer with merge-and-reset every N steps."""
+
+    model: object
+    lora_cfg: LoraConfig
+    optimizer: object
+    relora_steps: int = 100
+    seed: int = 0
+
+    def __post_init__(self):
+        self.adapters = init_lora(
+            jax.random.PRNGKey(self.seed), self.model.config,
+            self.model.params, self.lora_cfg,
+        )
+        self.opt_state = self.optimizer.init(self.adapters)
+        self._step_fn = make_qlora_train_step(
+            self.model.config, self.optimizer, self.lora_cfg
+        )
+        self.step_count = 0
+
+    def step(self, tokens) -> float:
+        self.adapters, self.opt_state, loss = self._step_fn(
+            self.adapters, self.opt_state, jnp.asarray(tokens),
+            self.model.params,
+        )
+        self.step_count += 1
+        if self.step_count % self.relora_steps == 0:
+            self.merge_and_reset()
+        return float(loss)
+
+    def merge_and_reset(self):
+        """Fold adapters into the base, re-init adapters, reset their
+        optimizer state (reference relora.py:149 on_step_begin)."""
+        self.model.params = merge_lora(
+            self.model.params, self.adapters, self.lora_cfg
+        )
+        self.seed += 1
+        self.adapters = init_lora(
+            jax.random.PRNGKey(self.seed), self.model.config,
+            self.model.params, self.lora_cfg,
+        )
+        self.opt_state = self.optimizer.init(self.adapters)

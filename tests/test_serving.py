@@ -1,0 +1,243 @@
+"""Serving engine + OpenAI API correctness.
+
+The strong invariant: greedy requests running CONCURRENTLY through the
+continuous-batching engine must produce exactly the tokens that plain
+single-request generate produces — rows must not leak into each other.
+(The reference has no unit test for its serving stack; SURVEY.md §4.)
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.generation import GenerationConfig, generate
+from ipex_llm_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+    stream_tokens,
+)
+from tests.test_decoder import rand_params, tiny_cfg
+
+RNG = np.random.default_rng(21)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=131, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+@pytest.fixture(scope="module")
+def engine(cfg_params):
+    cfg, params = cfg_params
+    eng = ServingEngine(
+        cfg, params, EngineConfig(max_rows=3, max_seq_len=256,
+                                  prefill_bucket=32)
+    ).start()
+    yield eng
+    eng.stop()
+
+
+def _reference_tokens(cfg, params, prompt, n):
+    gen = GenerationConfig(max_new_tokens=n, do_sample=False)
+    res = generate(cfg, params, [prompt], gen)
+    return list(res.sequences[0, len(prompt):len(prompt) + n])
+
+
+def test_concurrent_requests_match_single(cfg_params, engine):
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (9, 17, 30)]
+    want = [_reference_tokens(cfg, params, p, 12) for p in prompts]
+
+    reqs = [engine.submit(Request(prompt_ids=p, max_new_tokens=12))
+            for p in prompts]
+    got = [list(stream_tokens(r)) for r in reqs]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    assert all(r.finish_reason == "length" for r in reqs)
+
+
+def test_more_requests_than_rows(cfg_params, engine):
+    """5 requests through 3 rows: queueing + row reuse must stay isolated."""
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, 8 + 3 * i))
+               for i in range(5)]
+    want = [_reference_tokens(cfg, params, p, 8) for p in prompts]
+    reqs = [engine.submit(Request(prompt_ids=p, max_new_tokens=8))
+            for p in prompts]
+    got = [list(stream_tokens(r)) for r in reqs]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_eos_stops_row(cfg_params, engine):
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(0, cfg.vocab_size, 10))
+    ref = _reference_tokens(cfg, params, prompt, 12)
+    eos = int(ref[3])
+    req = engine.submit(Request(prompt_ids=prompt, max_new_tokens=12,
+                                eos_token_id=(eos,)))
+    got = list(stream_tokens(req))
+    assert got == ref[:4]
+    assert req.finish_reason == "stop"
+
+
+def test_oversized_request_rejected(engine):
+    req = engine.submit(Request(prompt_ids=[1] * 250, max_new_tokens=100))
+    assert list(stream_tokens(req)) == []
+    assert req.finish_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+
+class _Tok:
+    """Minimal id-passthrough tokenizer for HTTP tests."""
+
+    eos_token_id = None
+    chat_template = None
+
+    def __call__(self, text):
+        def tid(x):
+            try:
+                return int(x) % 131
+            except ValueError:
+                return hash(x) % 131
+        return {"input_ids": [tid(x) for x in text.split()]}
+
+    def decode(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+@pytest.fixture(scope="module")
+def http_server(cfg_params):
+    aiohttp = pytest.importorskip("aiohttp")
+    import asyncio
+
+    from ipex_llm_tpu.serving.api_server import OpenAIServer
+    from aiohttp import web
+
+    cfg, params = cfg_params
+    eng = ServingEngine(
+        cfg, params, EngineConfig(max_rows=2, max_seq_len=128,
+                                  prefill_bucket=32)
+    ).start()
+    srv = OpenAIServer(eng, _Tok(), "tiny")
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(srv.app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        port_holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    yield port_holder["port"]
+    loop.call_soon_threadsafe(loop.stop)
+    eng.stop()
+
+
+def _post(port, path, body):
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def test_http_completions(http_server):
+    port = http_server
+    resp = _post(port, "/v1/completions",
+                 {"prompt": "1 2 3 4 5 6", "max_tokens": 6})
+    body = json.loads(resp.read())
+    assert body["object"] == "text_completion"
+    assert len(body["choices"][0]["text"].split()) == 6
+
+
+def test_http_chat_stream_two_in_flight(http_server):
+    """Two streaming chat requests in flight; both must complete with SSE."""
+    port = http_server
+    results = {}
+
+    def worker(name, msg):
+        resp = _post(port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": msg}],
+            "max_tokens": 8, "stream": True,
+        })
+        chunks = []
+        for line in resp:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunks.append(json.loads(line[6:]))
+        results[name] = chunks
+
+    t1 = threading.Thread(target=worker, args=("a", "7 8 9 10"))
+    t2 = threading.Thread(target=worker, args=("b", "11 12 13 14 15"))
+    t1.start(); t2.start()
+    t1.join(120); t2.join(120)
+    for name in ("a", "b"):
+        chunks = results[name]
+        pieces = [c["choices"][0]["delta"].get("content", "")
+                  for c in chunks]
+        assert sum(1 for p in pieces if p) == 8
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_abort_frees_row(cfg_params, engine):
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(0, cfg.vocab_size, 12))
+    req = engine.submit(Request(prompt_ids=prompt, max_new_tokens=200))
+    # read a couple of tokens, then hang up
+    got = [req.stream_queue.get(timeout=60) for _ in range(2)]
+    assert all(t is not None for t in got)
+    engine.abort(req)
+    # the stream must terminate (None) well before 200 tokens
+    rest = list(stream_tokens(req))
+    assert len(got) + len(rest) < 200
+    assert req.finish_reason == "abort"
+
+
+def test_http_stop_sequence(http_server):
+    """A stop string truncates output and finishes with reason 'stop'."""
+    port = http_server
+    # discover the greedy continuation first
+    resp = _post(port, "/v1/completions",
+                 {"prompt": "20 21 22 23 24", "max_tokens": 6})
+    full = json.loads(resp.read())["choices"][0]["text"].split()
+    stop_word = full[2]
+    resp = _post(port, "/v1/completions",
+                 {"prompt": "20 21 22 23 24", "max_tokens": 6,
+                  "stop": stop_word})
+    body = json.loads(resp.read())
+    text = body["choices"][0]["text"]
+    assert stop_word not in text.split()
+    assert body["choices"][0]["finish_reason"] == "stop"
+
+
+def test_http_models_and_health(http_server):
+    port = http_server
+    body = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/models", timeout=30).read())
+    assert body["data"][0]["id"] == "tiny"
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/health", timeout=30).read())
+    assert health["status"] == "ok"
