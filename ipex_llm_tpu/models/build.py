@@ -61,6 +61,7 @@ def build_params(
     mixed_precision: bool = False,
     progress: Callable[[str], None] | None = None,
     moe_scheme=None,
+    embedding_qtype: str | None = None,
 ) -> dict[str, Any]:
     """Assemble the full decoder param pytree, quantizing as it streams.
 
@@ -176,7 +177,13 @@ def build_params(
         layers.append(lp)
 
     params: dict[str, Any] = {"layers": stack_layer_trees(layers)}
-    params["embed"] = jnp.asarray(get(scheme.embed), jnp.bfloat16)
+    if embedding_qtype and not cfg.tie_word_embeddings:
+        # LowBitEmbedding equivalent (reference embedding.py:179): table
+        # quantized [vocab, hidden] with vocab as the block axis; rows
+        # dequantize at gather time (ops/embedding.py)
+        params["embed"] = qcore.quantize(get(scheme.embed), embedding_qtype)
+    else:
+        params["embed"] = jnp.asarray(get(scheme.embed), jnp.bfloat16)
     params["final_norm"] = jnp.asarray(get(scheme.final_norm), NORM_DTYPE)
 
     if cfg.tie_word_embeddings:

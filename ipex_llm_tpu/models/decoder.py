@@ -200,9 +200,11 @@ def decoder_forward(
     with per-row offsets (continuous batching); the returned cache's
     ``length`` is then left untouched — the caller tracks row lengths.
     """
+    from ipex_llm_tpu.ops.embedding import embed_lookup
+
     b, t = tokens.shape
     embed = params["embed"]
-    x = jnp.take(embed, tokens, axis=0).astype(COMPUTE_DTYPE)
+    x = embed_lookup(embed, tokens, COMPUTE_DTYPE)
     if cfg.embedding_multiplier != 1.0:
         x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
 

@@ -87,6 +87,14 @@ class TPUModelForCausalLM:
         mixed_precision = kwargs.pop("mixed_precision", False)
         mesh = kwargs.pop("mesh", None)
         speculative = kwargs.pop("speculative", False)
+        embedding_qtype = kwargs.pop("embedding_qtype", None)
+        # the reference offloads the table to host/disk to save GPU memory
+        # (embedding.py:58,96); the TPU lever is HBM, so these flags map to
+        # the quantized-in-HBM table (in-jit row dequant, no host sync)
+        if kwargs.pop("cpu_embedding", False) or kwargs.pop(
+            "disk_embedding", False
+        ):
+            embedding_qtype = embedding_qtype or "sym_int8"
         kwargs.pop("optimize_model", True)
         kwargs.pop("torch_dtype", None)
         kwargs.pop("trust_remote_code", None)
@@ -98,7 +106,7 @@ class TPUModelForCausalLM:
         params = build_params(
             cfg, family.scheme, reader.get, reader.has,
             qtype=qtype, mixed_precision=mixed_precision,
-            moe_scheme=family.moe,
+            moe_scheme=family.moe, embedding_qtype=embedding_qtype,
         )
         model = cls(cfg, params, hf_config, qtype)
         if speculative:

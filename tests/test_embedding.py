@@ -1,0 +1,55 @@
+"""Low-bit embedding lookup correctness (reference embedding.py:179)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.ops.embedding import embed_lookup
+from ipex_llm_tpu.quantize import core as qcore
+
+RNG = np.random.default_rng(17)
+
+
+@pytest.mark.parametrize("qtype", ["sym_int8", "sym_int4", "nf4", "fp4"])
+def test_lookup_matches_full_dequant(qtype):
+    vocab, hidden = 160, 48
+    table = RNG.standard_normal((vocab, hidden)).astype(np.float32)
+    qt = qcore.quantize(table, qtype)
+    full = np.asarray(qcore.dequantize(qt))       # [vocab, hidden]
+    ids = jnp.asarray(RNG.integers(0, vocab, (3, 7)))
+    rows = np.asarray(embed_lookup(qt, ids, jnp.float32))
+    np.testing.assert_allclose(rows, full[np.asarray(ids)], atol=1e-3,
+                               rtol=1e-3)
+
+
+def test_model_with_quantized_embedding(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=192, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(cfg).eval()
+    hf.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m_dense = AutoModelForCausalLM.from_pretrained(
+        str(tmp_path), load_in_low_bit="bf16")
+    m_q = AutoModelForCausalLM.from_pretrained(
+        str(tmp_path), load_in_low_bit="bf16", embedding_qtype="sym_int8")
+    m_cpu = AutoModelForCausalLM.from_pretrained(
+        str(tmp_path), load_in_low_bit="bf16", cpu_embedding=True)
+
+    assert isinstance(m_q.params["embed"], qcore.QTensor)
+    assert isinstance(m_cpu.params["embed"], qcore.QTensor)
+    tokens = RNG.integers(0, 192, (2, 9)).astype(np.int32)
+    want = np.asarray(m_dense(tokens))
+    got = np.asarray(m_q(tokens))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.08
+    agree = (got.argmax(-1) == want.argmax(-1)).mean()
+    assert agree > 0.85
