@@ -62,6 +62,7 @@ def build_params(
     progress: Callable[[str], None] | None = None,
     moe_scheme=None,
     embedding_qtype: str | None = None,
+    qkv_transform: Callable | None = None,
 ) -> dict[str, Any]:
     """Assemble the full decoder param pytree, quantizing as it streams.
 
@@ -79,13 +80,23 @@ def build_params(
             return None
         return get(n)
 
+    def norm_with_bias(lp: dict, key: str, tmpl: str | None, i: int | None,
+                       required: bool = False):
+        n = name(tmpl, i)
+        if n is None or (not required and not has(n)):
+            return
+        lp[key] = jnp.asarray(get(n), NORM_DTYPE)
+        bias_name = n[: -len(".weight")] + ".bias" if n.endswith(".weight") else None
+        if bias_name is not None and has(bias_name):
+            lp[key + "_bias"] = jnp.asarray(get(bias_name), NORM_DTYPE)
+
     layers = []
     for i in range(cfg.num_layers):
         if progress:
             progress(f"layer {i + 1}/{cfg.num_layers}")
         lp: dict[str, Any] = {}
-        lp["attn_norm"] = jnp.asarray(get(name(scheme.attn_norm, i)), NORM_DTYPE)
-        lp["mlp_norm"] = jnp.asarray(get(name(scheme.mlp_norm, i)), NORM_DTYPE)
+        norm_with_bias(lp, "attn_norm", scheme.attn_norm, i, required=True)
+        norm_with_bias(lp, "mlp_norm", scheme.mlp_norm, i, required=True)
         for key, tmpl in (
             ("post_attn_norm", scheme.post_attn_norm),
             ("post_mlp_norm", scheme.post_mlp_norm),
@@ -100,6 +111,12 @@ def build_params(
         if scheme.qkv is not None:
             qkv_w = get(name(scheme.qkv, i))
             qkv_b = get_opt(name(scheme.qkv, i, "bias"))
+            if qkv_transform is not None:
+                # family-specific packed layout (gpt-neox interleave,
+                # internlm2 grouped wqkv) -> [q; k; v] concat order
+                qkv_w = qkv_transform(qkv_w, cfg)
+                if qkv_b is not None:
+                    qkv_b = qkv_transform(qkv_b[:, None], cfg)[:, 0]
         else:
             qw = get(name(scheme.q, i))
             kw = get(name(scheme.k, i))
@@ -156,6 +173,19 @@ def build_params(
             layers.append(lp)
             continue
 
+        # --- non-gated mlp (phi/gpt-neox/starcoder2: fc1 -> act -> fc2)
+        if scheme.gate_up is None and scheme.gate is None:
+            lp["up"] = quantize_weight(get(name(scheme.up, i)), qtype)
+            ub = get_opt(name(scheme.up, i, "bias"))
+            if ub is not None:
+                lp["up_bias"] = jnp.asarray(ub, jnp.float32)
+            lp["down"] = quantize_weight(get(name(scheme.down, i)), qtype)
+            db = get_opt(name(scheme.down, i, "bias"))
+            if db is not None:
+                lp["down_bias"] = jnp.asarray(db, jnp.float32)
+            layers.append(lp)
+            continue
+
         # --- mlp (merged gate_up)
         if scheme.gate_up is not None:
             gu_w = get(name(scheme.gate_up, i))
@@ -185,6 +215,9 @@ def build_params(
     else:
         params["embed"] = jnp.asarray(get(scheme.embed), jnp.bfloat16)
     params["final_norm"] = jnp.asarray(get(scheme.final_norm), NORM_DTYPE)
+    fn_bias = scheme.final_norm[: -len(".weight")] + ".bias"
+    if scheme.final_norm.endswith(".weight") and has(fn_bias):
+        params["final_norm_bias"] = jnp.asarray(get(fn_bias), NORM_DTYPE)
 
     if cfg.tie_word_embeddings:
         pass  # decoder uses embed.T
@@ -194,6 +227,9 @@ def build_params(
         # reference is_lm_head mixed-precision rule (convert.py:126): keep
         # big-vocab heads at >=8 bit when mixed_precision is requested
         params["lm_head"] = quantize_weight(lm_w, head_q)
+        head_bias = scheme.lm_head[: -len(".weight")] + ".bias"
+        if scheme.lm_head.endswith(".weight") and has(head_bias):
+            params["lm_head_bias"] = jnp.asarray(get(head_bias), jnp.float32)
 
     if cfg.rope is not None:
         params["inv_freq"] = jnp.asarray(

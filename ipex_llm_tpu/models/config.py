@@ -48,6 +48,10 @@ class ModelConfig:
     mlp_bias: bool = False
     tie_word_embeddings: bool = False
 
+    # block structure
+    mlp_gated: bool = True        # False: fc1 -> act -> fc2 (phi/gptneox)
+    parallel_blocks: bool = False  # x + attn(ln(x)) + mlp(ln'(x)) (phi/neox)
+
     # attention extras
     sliding_window: int | None = None
     layer_types: tuple[str, ...] | None = None  # per-layer 'full'|'sliding'

@@ -40,11 +40,15 @@ def _norm(x, w, cfg: ModelConfig, bias=None):
     return rms_norm(x, w, cfg.norm_eps, cfg.norm_offset)
 
 
+def _in_norm(x, lp, key, cfg):
+    return _norm(x, lp[key], cfg, lp.get(key + "_bias"))
+
+
 def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
                      q_slots, kv_len, kv_start, sliding, cache: KVCache,
                      collect_obs: int = 0):
     b, t, _ = x.shape
-    h = _norm(x, lp["attn_norm"], cfg)
+    h = _in_norm(x, lp, "attn_norm", cfg)
     q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
     if "qkv" in lp:
         qkv = linear_ops.linear(h, lp["qkv"], lp.get("qkv_bias"))
@@ -163,14 +167,20 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
 
 
 def _mlp_block(cfg: ModelConfig, lp: dict, x):
-    h = _norm(x, lp["mlp_norm"], cfg)
-    if "gate_up" in lp:
-        gate_up = linear_ops.linear(h, lp["gate_up"], lp.get("gate_up_bias"))
-        gate, up = mlp_ops.split_gate_up(gate_up)
+    h = _in_norm(x, lp, "mlp_norm", cfg)
+    if not cfg.mlp_gated:
+        # fc1 -> act -> fc2 (phi/gptneox/starcoder2-style MLP)
+        inner = mlp_ops.act(
+            linear_ops.linear(h, lp["up"], lp.get("up_bias")), cfg.act
+        )
     else:
-        gate = linear_ops.linear(h, lp["gate"], lp.get("gate_bias"))
-        up = linear_ops.linear(h, lp["up"], lp.get("up_bias"))
-    inner = mlp_ops.gated_act_mul(gate, up, cfg.act)
+        if "gate_up" in lp:
+            gate_up = linear_ops.linear(h, lp["gate_up"], lp.get("gate_up_bias"))
+            gate, up = mlp_ops.split_gate_up(gate_up)
+        else:
+            gate = linear_ops.linear(h, lp["gate"], lp.get("gate_bias"))
+            up = linear_ops.linear(h, lp["up"], lp.get("up_bias"))
+        inner = mlp_ops.gated_act_mul(gate, up, cfg.act)
     out = linear_ops.linear(inner, lp["down"], lp.get("down_bias"))
     if cfg.post_mlp_norm:
         out = _norm(out, lp["post_mlp_norm"], cfg)
@@ -233,16 +243,20 @@ def decoder_forward(
             cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
             sliding, cache, collect_obs,
         )
-        x = x + attn_out
         ffn = _moe_block if "moe_gate_up" in lp else _mlp_block
-        x = x + ffn(cfg, lp, x)
+        if cfg.parallel_blocks:
+            # x + attn(ln(x)) + mlp(ln'(x)) — phi/gpt-neox parallel residual
+            x = x + attn_out + ffn(cfg, lp, x)
+        else:
+            x = x + attn_out
+            x = x + ffn(cfg, lp, x)
         return x, (kl, vl, obs_q)
 
     x, (k_new, v_new, obs_q) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v, sliding_flags)
     )
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
 
     if last_token_only:
         x = x[:, -1, :]  # left-padding puts every sequence's last token at T-1
@@ -254,7 +268,9 @@ def decoder_forward(
             preferred_element_type=jnp.float32,
         )
     else:
-        logits = linear_ops.linear(x, lm_head).astype(jnp.float32)
+        logits = linear_ops.linear(
+            x, lm_head, params.get("lm_head_bias")
+        ).astype(jnp.float32)
     if cfg.logit_softcap is not None:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
 

@@ -64,6 +64,9 @@ class Family:
     to_config: Callable[[dict], ModelConfig]
     scheme: WeightScheme = field(default_factory=WeightScheme)
     moe: MoEScheme | None = None
+    # packed-qkv layout fixup -> [q_all; k_all; v_all] rows (applied before
+    # quantization; the _optimize_pre weight-rewrite equivalent)
+    qkv_transform: Callable | None = None
 
 
 def _rope_from_hf(hf: dict, head_dim: int) -> RopeScaling:
@@ -215,6 +218,129 @@ def _qwen3_moe(hf: dict) -> ModelConfig:
     ))
 
 
+def _phi(hf: dict) -> ModelConfig:
+    """phi-1/phi-2: parallel attn+mlp off ONE shared layernorm, partial
+    rotary, non-gated gelu MLP, biases everywhere."""
+    return ModelConfig(**_base_cfg(
+        hf,
+        norm_kind="layer",
+        norm_eps=hf.get("layer_norm_eps", 1e-5),
+        act=hf.get("hidden_act", "gelu_new"),
+        mlp_gated=False,
+        parallel_blocks=True,
+        attention_bias=True,
+        attention_out_bias=True,
+    ))
+
+
+def _gptneox(hf: dict) -> ModelConfig:
+    hf2 = dict(hf)
+    hf2.setdefault("partial_rotary_factor", hf.get("rotary_pct", 1.0))
+    hf2.setdefault("rope_theta", hf.get("rotary_emb_base", 10000.0))
+    return ModelConfig(**_base_cfg(
+        hf2,
+        norm_kind="layer",
+        norm_eps=hf.get("layer_norm_eps", 1e-5),
+        act=hf.get("hidden_act", "gelu"),
+        mlp_gated=False,
+        parallel_blocks=hf.get("use_parallel_residual", True),
+        attention_bias=hf.get("attention_bias", True),
+        attention_out_bias=True,
+    ))
+
+
+def _starcoder2(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(
+        hf,
+        norm_kind="layer",
+        norm_eps=hf.get("norm_epsilon", hf.get("layer_norm_eps", 1e-5)),
+        act=hf.get("hidden_act", "gelu_pytorch_tanh"),
+        mlp_gated=False,
+        attention_bias=hf.get("use_bias", True),
+        attention_out_bias=hf.get("use_bias", True),
+        sliding_window=hf.get("sliding_window"),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+    ))
+
+
+def _baichuan(hf: dict) -> ModelConfig:
+    if hf.get("hidden_size", 0) >= 5120:
+        raise NotImplementedError(
+            "baichuan-13B uses ALiBi position encoding (not supported yet); "
+            "the 7B rope variants load fine"
+        )
+    return ModelConfig(**_base_cfg(hf))
+
+
+def _internlm2(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(hf, attention_bias=hf.get("bias", False)))
+
+
+def _neox_qkv(w, cfg: ModelConfig):
+    """GPT-NeoX query_key_value: per-head [q_i;k_i;v_i] interleave ->
+    [q_all; k_all; v_all]."""
+    h, hd = cfg.num_heads, cfg.head_dim
+    return (
+        w.reshape(h, 3, hd, -1).transpose(1, 0, 2, 3).reshape(3 * h * hd, -1)
+    )
+
+
+def _internlm2_qkv(w, cfg: ModelConfig):
+    """internlm2 wqkv: per-kv-group [q*ratio; k; v] -> [q_all; k_all; v_all]."""
+    g, hd = cfg.num_kv_heads, cfg.head_dim
+    per = cfg.num_heads // g
+    x = w.reshape(g, per + 2, hd, -1)
+    q = x[:, :per].reshape(g * per * hd, -1)
+    k = x[:, per].reshape(g * hd, -1)
+    v = x[:, per + 1].reshape(g * hd, -1)
+    return np.concatenate([q, k, v], axis=0)
+
+
+_PHI_SCHEME = WeightScheme(
+    final_norm="model.final_layernorm.weight",
+    o="model.layers.{i}.self_attn.dense.{p}",
+    gate=None,
+    up="model.layers.{i}.mlp.fc1.{p}",
+    gate_up=None,
+    down="model.layers.{i}.mlp.fc2.{p}",
+    # ONE layernorm feeds both parallel branches
+    mlp_norm="model.layers.{i}.input_layernorm.weight",
+)
+_GPTNEOX_SCHEME = WeightScheme(
+    embed="gpt_neox.embed_in.weight",
+    final_norm="gpt_neox.final_layer_norm.weight",
+    lm_head="embed_out.weight",
+    attn_norm="gpt_neox.layers.{i}.input_layernorm.weight",
+    mlp_norm="gpt_neox.layers.{i}.post_attention_layernorm.weight",
+    qkv="gpt_neox.layers.{i}.attention.query_key_value.{p}",
+    q=None, k=None, v=None,
+    o="gpt_neox.layers.{i}.attention.dense.{p}",
+    gate=None, gate_up=None,
+    up="gpt_neox.layers.{i}.mlp.dense_h_to_4h.{p}",
+    down="gpt_neox.layers.{i}.mlp.dense_4h_to_h.{p}",
+)
+_STARCODER2_SCHEME = WeightScheme(
+    gate=None, gate_up=None,
+    up="model.layers.{i}.mlp.c_fc.{p}",
+    down="model.layers.{i}.mlp.c_proj.{p}",
+)
+_BAICHUAN_SCHEME = WeightScheme(
+    qkv="model.layers.{i}.self_attn.W_pack.{p}",
+    q=None, k=None, v=None,
+)
+_INTERNLM2_SCHEME = WeightScheme(
+    embed="model.tok_embeddings.weight",
+    lm_head="output.weight",
+    attn_norm="model.layers.{i}.attention_norm.weight",
+    mlp_norm="model.layers.{i}.ffn_norm.weight",
+    qkv="model.layers.{i}.attention.wqkv.{p}",
+    q=None, k=None, v=None,
+    o="model.layers.{i}.attention.wo.{p}",
+    gate="model.layers.{i}.feed_forward.w1.{p}",
+    up="model.layers.{i}.feed_forward.w3.{p}",
+    down="model.layers.{i}.feed_forward.w2.{p}",
+)
+
 _MIXTRAL_MOE = MoEScheme(
     router="model.layers.{i}.block_sparse_moe.gate.weight",
     e_gate="model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
@@ -251,6 +377,13 @@ FAMILIES: dict[str, Family] = {
     ),
     "gemma": Family("gemma", _gemma, _GEMMA_SCHEME),
     "gemma2": Family("gemma2", _gemma2, _GEMMA2_SCHEME),
+    "phi": Family("phi", _phi, _PHI_SCHEME),
+    "gpt_neox": Family("gpt_neox", _gptneox, _GPTNEOX_SCHEME,
+                       qkv_transform=_neox_qkv),
+    "starcoder2": Family("starcoder2", _starcoder2, _STARCODER2_SCHEME),
+    "baichuan": Family("baichuan", _baichuan, _BAICHUAN_SCHEME),
+    "internlm2": Family("internlm2", _internlm2, _INTERNLM2_SCHEME,
+                        qkv_transform=_internlm2_qkv),
     "mixtral": Family("mixtral", _mixtral, WeightScheme(), _MIXTRAL_MOE),
     "qwen2_moe": Family("qwen2_moe", _qwen2_moe, WeightScheme(), _QWEN2_MOE),
     "qwen3_moe": Family(

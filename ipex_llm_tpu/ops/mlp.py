@@ -16,12 +16,18 @@ import jax.numpy as jnp
 
 ACT_FNS = {
     "silu": jax.nn.silu,
-    "gelu": jax.nn.gelu,
+    # HF "gelu" is the exact erf form; jax.nn.gelu defaults to tanh-approx
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
     "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
     "relu": jax.nn.relu,
     "relu2": lambda x: jnp.square(jax.nn.relu(x)),
 }
+
+
+def act(x: jnp.ndarray, name: str = "gelu_new") -> jnp.ndarray:
+    """Plain activation (non-gated MLPs: phi/gpt-neox/starcoder2)."""
+    return ACT_FNS[name](x.astype(jnp.float32)).astype(x.dtype)
 
 
 def gated_act_mul(gate: jnp.ndarray, up: jnp.ndarray, act: str = "silu") -> jnp.ndarray:

@@ -17,7 +17,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-AXES = ("dp", "tp", "ep", "cp")
+AXES = ("dp", "pp", "tp", "ep", "cp")
 
 
 @dataclass(frozen=True)
@@ -26,13 +26,15 @@ class MeshSpec:
     tp: int = 1
     ep: int = 1
     cp: int = 1
+    pp: int = 1  # pipeline stages: layer-stack axis sharded over this
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.ep * self.cp
+        return self.dp * self.tp * self.ep * self.cp * self.pp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"dp": self.dp, "tp": self.tp, "ep": self.ep, "cp": self.cp}
+        return {"dp": self.dp, "pp": self.pp, "tp": self.tp, "ep": self.ep,
+                "cp": self.cp}
 
 
 def make_mesh(spec: MeshSpec | None = None, devices=None, **axis_sizes) -> Mesh:
@@ -49,8 +51,10 @@ def make_mesh(spec: MeshSpec | None = None, devices=None, **axis_sizes) -> Mesh:
         raise ValueError(
             f"mesh {spec} needs {spec.size} devices, have {len(devices)}"
         )
-    arr = np.asarray(devices[: spec.size]).reshape(spec.dp, spec.cp, spec.ep, spec.tp)
-    return Mesh(arr, ("dp", "cp", "ep", "tp"))
+    arr = np.asarray(devices[: spec.size]).reshape(
+        spec.dp, spec.pp, spec.cp, spec.ep, spec.tp
+    )
+    return Mesh(arr, ("dp", "pp", "cp", "ep", "tp"))
 
 
 def single_device_mesh() -> Mesh:

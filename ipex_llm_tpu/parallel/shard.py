@@ -37,15 +37,20 @@ def _divisible(n: int, parts: int) -> bool:
 
 
 def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
-                  ep: int = 1) -> P:
+                  ep: int = 1, pp: int = 1) -> P:
     """Pick the PartitionSpec for a QTensor's data/scales planes.
 
     All planes are laid out ``[(L,)? (E,)? in_like, out]``; col-parallel
-    shards the last axis, row-parallel the in-like axis; an expert axis (MoE
-    stacks, data ndim 4) is sharded over ``ep``.  Falls back to replication
-    when the packed/block axis does not divide evenly.
+    shards the last axis, row-parallel the in-like axis; the stacked layer
+    axis is sharded over ``pp`` (stage-sequential pipeline — the reference's
+    per-rank layer slices, pipeline_parallel.py:166-234, without the
+    process groups) and an expert axis (MoE stacks) over ``ep``.  Falls
+    back to replication when an axis does not divide evenly.
     """
-    lead: tuple = (None,) if stacked else ()
+    lead: tuple = ()
+    if stacked:
+        n_l = qt.data.shape[0]
+        lead = ("pp" if pp > 1 and _divisible(n_l, pp) else None,)
     if qt.data.ndim == 2 + len(lead) + 1:  # extra expert axis
         n_experts = qt.data.shape[len(lead)]
         lead = lead + ("ep" if _divisible(n_experts, ep) and ep > 1 else None,)
@@ -61,12 +66,22 @@ def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
 def param_shardings(params: dict, mesh: Mesh) -> dict:
     """Build a sharding pytree matching ``params`` (QTensor-aware)."""
     tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape.get("ep", 1)
+    pp = mesh.shape.get("pp", 1)
+    n_layers = None
+    for v in params["layers"].values():
+        leaf = v.data if isinstance(v, QTensor) else v
+        n_layers = leaf.shape[0]
+        break
 
     def ns(spec: P) -> NamedSharding:
         return NamedSharding(mesh, spec)
 
+    def lead_pp():
+        return "pp" if pp > 1 and _divisible(n_layers or 0, pp) else None
+
     def qt_sharding(qt: QTensor, kind: str, stacked: bool):
-        spec = _qtensor_spec(qt, kind, tp, stacked)
+        spec = _qtensor_spec(qt, kind, tp, stacked, ep=ep, pp=pp)
         return QTensor(
             data=ns(spec),
             scales=None if qt.scales is None else ns(spec),
@@ -83,15 +98,22 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
                 return qt_sharding(v, "row", stacked)
             return qt_sharding(v, "rep", stacked)
         if key in _COL_BIAS and _divisible(v.shape[-1], tp):
-            return ns(P(None, "tp"))
-        return ns(P())
+            return ns(P(lead_pp(), "tp"))
+        # stacked per-layer vectors (norms, routers): stage-shard the L axis
+        spec = (lead_pp(),) + (None,) * (v.ndim - 1)
+        return ns(P(*spec))
 
     out: dict[str, Any] = {}
     for key, v in params.items():
         if key == "layers":
             out[key] = {k: layer_entry(k, sub) for k, sub in v.items()}
-        elif key == "embed" and _divisible(v.shape[0], tp):
-            out[key] = ns(P("tp", None))
+        elif key == "embed":
+            if isinstance(v, QTensor):  # quantized table: vocab-block shard
+                out[key] = qt_sharding(v, "row", stacked=False)
+            elif _divisible(v.shape[0], tp):
+                out[key] = ns(P("tp", None))
+            else:
+                out[key] = ns(P())
         elif key == "lm_head":
             if isinstance(v, QTensor):
                 out[key] = qt_sharding(v, "col", stacked=False)
@@ -122,14 +144,17 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
     return out
 
 
-def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int = 0) -> NamedSharding:
-    """KV cache [L, B, S, Hkv, D]: batch over dp, heads over tp (when they
-    divide; GQA with fewer kv heads than tp replicates instead)."""
+def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int = 0,
+                   n_layers: int = 0) -> NamedSharding:
+    """KV cache [L, B, S, Hkv, D]: layers over pp, batch over dp, heads over
+    tp (when they divide; GQA with fewer kv heads than tp replicates)."""
     tp = mesh.shape.get("tp", 1)
     dp = mesh.shape.get("dp", 1)
+    pp = mesh.shape.get("pp", 1)
     head_axis = "tp" if _divisible(n_kv_heads, tp) else None
     batch_axis = "dp" if _divisible(batch, dp) else None
-    return NamedSharding(mesh, P(None, batch_axis, None, head_axis, None))
+    layer_axis = "pp" if pp > 1 and _divisible(n_layers, pp) else None
+    return NamedSharding(mesh, P(layer_axis, batch_axis, None, head_axis, None))
 
 
 def data_sharding(mesh: Mesh, batch: int = 0) -> NamedSharding:
@@ -143,7 +168,7 @@ def shard_cache(cache, mesh: Mesh):
     """Place a KVCache pytree onto the mesh (k/v sharded, length replicated)."""
     n_kv_heads = cache.k.shape[3]
     batch = cache.k.shape[1]
-    kv_sh = cache_sharding(mesh, n_kv_heads, batch)
+    kv_sh = cache_sharding(mesh, n_kv_heads, batch, n_layers=cache.k.shape[0])
     rep = NamedSharding(mesh, P())
     from dataclasses import replace as _replace
 

@@ -1,0 +1,201 @@
+"""Model-family breadth: logits equivalence vs HF torch per family.
+
+Covers the architectures the reference patches in transformers/models/*.py:
+phi (parallel residual + partial rotary + non-gated MLP), gpt_neox
+(interleaved fused QKV), starcoder2 (layernorm+bias, tied head).  baichuan
+and internlm2 ship no mainline HF modeling code, so their packed-QKV layouts
+are validated by round-tripping a llama checkpoint through their weight
+naming (bit-identical math, different tensor packing).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOKENS = np.random.default_rng(0).integers(0, 150, (2, 10)).astype(np.int32)
+
+
+def _check(tmp_path, hf_model, name, tol=0.06, agree=0.85):
+    path = str(tmp_path / name)
+    hf_model.save_pretrained(path, safe_serialization=True)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    got = np.asarray(model(TOKENS))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < tol, np.abs(got - want).max() / scale
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > agree
+    return model
+
+
+def test_phi_logits(tmp_path):
+    from transformers import PhiConfig, PhiForCausalLM
+
+    cfg = PhiConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=256,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    _check(tmp_path, PhiForCausalLM(cfg).eval(), "phi")
+
+
+def test_gptneox_logits(tmp_path):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    cfg = GPTNeoXConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        rotary_pct=0.25, max_position_embeddings=256,
+        use_parallel_residual=True, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    _check(tmp_path, GPTNeoXForCausalLM(cfg).eval(), "neox")
+
+
+def test_gptneox_sequential_residual(tmp_path):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    cfg = GPTNeoXConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=1.0,
+        use_parallel_residual=False, tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    _check(tmp_path, GPTNeoXForCausalLM(cfg).eval(), "neox_seq")
+
+
+def test_starcoder2_logits(tmp_path):
+    from transformers import Starcoder2Config, Starcoder2ForCausalLM
+
+    cfg = Starcoder2Config(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, use_bias=True,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    _check(tmp_path, Starcoder2ForCausalLM(cfg).eval(), "sc2")
+
+
+# ---------------------------------------------------------------------------
+# packed-QKV layouts without mainline HF code: repack a llama checkpoint
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tiny_llama_sd(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False, max_position_embeddings=256,
+    )
+    torch.manual_seed(3)
+    model = LlamaForCausalLM(cfg).eval()
+    path = str(tmp_path / "llama_ref")
+    model.save_pretrained(path, safe_serialization=True)
+    sd = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    return cfg, model, sd
+
+
+def _save_synthetic(tmp_path, name, config: dict, tensors: dict):
+    import safetensors.numpy
+
+    path = tmp_path / name
+    path.mkdir()
+    safetensors.numpy.save_file(
+        {k: np.ascontiguousarray(v) for k, v in tensors.items()},
+        str(path / "model.safetensors"),
+    )
+    (path / "config.json").write_text(json.dumps(config))
+    return str(path)
+
+
+def test_baichuan_wpack_layout(tmp_path, tiny_llama_sd):
+    cfg, hf_model, sd = tiny_llama_sd
+    tensors = {}
+    for k, v in sd.items():
+        if ".q_proj." in k or ".k_proj." in k or ".v_proj." in k:
+            continue
+        tensors[k] = v
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}.self_attn."
+        tensors[p + "W_pack.weight"] = np.concatenate(
+            [sd[p + "q_proj.weight"], sd[p + "k_proj.weight"],
+             sd[p + "v_proj.weight"]], axis=0,
+        )
+    config = {
+        "model_type": "baichuan", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+    }
+    path = _save_synthetic(tmp_path, "baichuan", config, tensors)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    got = np.asarray(model(TOKENS))
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_internlm2_wqkv_layout(tmp_path, tiny_llama_sd):
+    cfg, hf_model, sd = tiny_llama_sd
+    h, hkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = cfg.hidden_size // h
+    per = h // hkv
+    tensors = {
+        "model.tok_embeddings.weight": sd["model.embed_tokens.weight"],
+        "model.norm.weight": sd["model.norm.weight"],
+        "output.weight": sd["lm_head.weight"],
+    }
+    for i in range(cfg.num_hidden_layers):
+        src = f"model.layers.{i}."
+        dst = f"model.layers.{i}."
+        tensors[dst + "attention_norm.weight"] = sd[src + "input_layernorm.weight"]
+        tensors[dst + "ffn_norm.weight"] = sd[src + "post_attention_layernorm.weight"]
+        q = sd[src + "self_attn.q_proj.weight"].reshape(hkv, per, hd, -1)
+        k = sd[src + "self_attn.k_proj.weight"].reshape(hkv, 1, hd, -1)
+        v = sd[src + "self_attn.v_proj.weight"].reshape(hkv, 1, hd, -1)
+        wqkv = np.concatenate([q, k, v], axis=1)  # [g, per+2, hd, hidden]
+        tensors[dst + "attention.wqkv.weight"] = wqkv.reshape(-1, cfg.hidden_size)
+        tensors[dst + "attention.wo.weight"] = sd[src + "self_attn.o_proj.weight"]
+        tensors[dst + "feed_forward.w1.weight"] = sd[src + "mlp.gate_proj.weight"]
+        tensors[dst + "feed_forward.w3.weight"] = sd[src + "mlp.up_proj.weight"]
+        tensors[dst + "feed_forward.w2.weight"] = sd[src + "mlp.down_proj.weight"]
+    config = {
+        "model_type": "internlm2", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256, "bias": False,
+    }
+    path = _save_synthetic(tmp_path, "internlm2", config, tensors)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    got = np.asarray(model(TOKENS))
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_baichuan_13b_alibi_rejected():
+    from ipex_llm_tpu.models.families import get_family
+
+    fam = get_family("baichuan")
+    with pytest.raises(NotImplementedError):
+        fam.to_config({
+            "model_type": "baichuan", "vocab_size": 64000,
+            "hidden_size": 5120, "intermediate_size": 13696,
+            "num_hidden_layers": 40, "num_attention_heads": 40,
+        })

@@ -84,6 +84,35 @@ def test_sharded_generate_matches_unsharded(cfg_params, spec):
     np.testing.assert_array_equal(got.sequences, want.sequences)
 
 
+@pytest.mark.parametrize("spec", [MeshSpec(pp=2), MeshSpec(pp=2, tp=2),
+                                  MeshSpec(dp=2, pp=2, tp=2)])
+def test_pipeline_parallel_logits(cfg_params, spec):
+    """Layer-stack sharded over pp (stage-sequential pipeline): logits must
+    match single-device (reference pipeline_parallel.py:300 equivalence)."""
+    cfg, params = cfg_params
+    tokens = RNG.integers(0, cfg.vocab_size, (2, 9)).astype(np.int32)
+    want = _logits(cfg, params, tokens)
+
+    mesh = make_mesh(spec)
+    sharded = shard_params(params, mesh)
+    qkv = sharded["layers"]["qkv"]
+    # the layer axis is really split across stages
+    assert qkv.data.sharding.shard_shape(qkv.data.shape)[0] == cfg.num_layers // 2
+    got = _logits(cfg, sharded, tokens, mesh)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+def test_pp_generate_matches(cfg_params):
+    cfg, params = cfg_params
+    gen = GenerationConfig(max_new_tokens=8, do_sample=False)
+    prompts = [list(RNG.integers(0, cfg.vocab_size, 11))]
+    want = generate(cfg, params, prompts, gen)
+    mesh = make_mesh(MeshSpec(pp=2, tp=4))
+    sharded = shard_params(params, mesh)
+    got = generate(cfg, sharded, prompts, gen, mesh=mesh)
+    np.testing.assert_array_equal(got.sequences, want.sequences)
+
+
 def test_param_shardings_shapes(cfg_params):
     """Col weights shard the out axis, row weights the in axis."""
     cfg, params = cfg_params
