@@ -1,0 +1,131 @@
+"""All-in-one benchmark runner (reference dev/benchmark/all-in-one/run.py).
+
+YAML-driven matrix: model × in/out pair × low_bit × batch, emitting one CSV
+row + JSON line per combination with the reference's metrics (first-token
+latency, decode tok/s).  Models can be local HF checkpoint dirs, low-bit
+dirs, or synthetic ``random:<size>`` shapes (tiny/1b/7b) for hermetic runs.
+
+Usage: python benchmark/run.py [config.yaml]
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+import time
+
+DEFAULT_CONFIG = {
+    # reference config.yaml:12-15 protocol
+    "repo_id": ["random:tiny"],
+    "in_out_pairs": ["32-32", "1024-128"],
+    "low_bit": ["sym_int4"],
+    "batch_size": [1],
+    "api": ["transformers"],  # transformers | speculative | lookup
+    "warm_up": 1,
+    "num_trials": 1,
+}
+
+
+def _load_model(repo: str, low_bit: str):
+    if repo.startswith("random:"):
+        from ipex_llm_tpu.models.random_init import llama_config, random_params
+
+        size = repo.split(":", 1)[1]
+        dims = {
+            "tiny": dict(hidden_size=256, intermediate_size=1024,
+                         num_layers=4, num_heads=8, num_kv_heads=4,
+                         vocab_size=1024),
+            "1b": dict(hidden_size=2048, intermediate_size=5632,
+                       num_layers=22, num_heads=32, num_kv_heads=4,
+                       vocab_size=32000),
+            "7b": dict(hidden_size=4096, intermediate_size=11008,
+                       num_layers=32, num_heads=32, num_kv_heads=32,
+                       vocab_size=32000),
+        }[size]
+        cfg = llama_config(max_position_embeddings=4096, **dims)
+        return cfg, random_params(cfg, qtype=low_bit)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    if os.path.exists(os.path.join(repo, "bigdl_config.json")):
+        m = AutoModelForCausalLM.load_low_bit(repo)
+    else:
+        m = AutoModelForCausalLM.from_pretrained(repo, load_in_low_bit=low_bit)
+    return m.config, m.params
+
+
+def run_one(cfg, params, api: str, n_in: int, n_out: int, batch: int,
+            warm_up: int, trials: int) -> dict:
+    import numpy as np
+
+    from ipex_llm_tpu.generation import GenerationConfig, generate
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, cfg.vocab_size, (batch, n_in)).astype(np.int32)
+    gen = GenerationConfig(max_new_tokens=n_out, do_sample=False)
+
+    def call():
+        if api == "speculative":
+            from ipex_llm_tpu.speculative import speculative_generate
+
+            return speculative_generate(cfg, params, [list(prompts[0])], gen)
+        if api == "lookup":
+            from ipex_llm_tpu.speculative import speculative_generate
+
+            return speculative_generate(cfg, params, [list(prompts[0])], gen,
+                                        lookup=True)
+        return generate(cfg, params, prompts, gen)
+
+    for _ in range(warm_up):
+        res = call()
+    best = None
+    for _ in range(trials):
+        res = call()
+        tok_s = (batch if api == "transformers" else 1) / max(
+            res.rest_token_s, 1e-9
+        )
+        if best is None or tok_s > best["decode_tok_s"]:
+            best = {"ttft_s": round(res.first_token_s, 4),
+                    "decode_tok_s": round(tok_s, 2)}
+    return best
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    config = dict(DEFAULT_CONFIG)
+    if argv:
+        import yaml
+
+        with open(argv[0]) as f:
+            config.update(yaml.safe_load(f) or {})
+
+    out_csv = config.get("output", "benchmark_results.csv")
+    rows = []
+    for repo in config["repo_id"]:
+        for low_bit in config["low_bit"]:
+            cfg, params = _load_model(repo, low_bit)
+            for api in config["api"]:
+                for pair in config["in_out_pairs"]:
+                    n_in, n_out = (int(x) for x in pair.split("-"))
+                    for batch in config["batch_size"]:
+                        if api != "transformers" and batch != 1:
+                            continue
+                        r = run_one(cfg, params, api, n_in, n_out, batch,
+                                    config["warm_up"], config["num_trials"])
+                        row = {
+                            "model": repo, "low_bit": low_bit, "api": api,
+                            "in_out": pair, "batch": batch, **r,
+                        }
+                        rows.append(row)
+                        print(json.dumps(row), flush=True)
+    if rows:
+        with open(out_csv, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+// Native block quantizer — the ggml CPU quantizer equivalent
+// (reference: ggml_quantize_tensor via ctypes, low_bit_linear.py:106-279;
+// per-ISA libllama_*.so).  Bit-exact with quantize/core.py::_quant_int_sym:
+//   d = signed_absmax / -qmax;  q = clip(nearbyint(x/d) + qmax, 0, 2*qmax-1)
+// 4-bit codes pack with the block-local halves pairing (_pack_nibbles).
+//
+// Layout: w is [n_in, n_out] row-major (contraction axis first, the QTensor
+// convention); scales are fp16 [n_blocks, n_out]; data is
+// [n_in/2, n_out] (4-bit) or [n_in, n_out] (8-bit) uint8.
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC quantize.cpp
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+static inline uint16_t f32_to_f16(float f) {
+#if defined(__F16C__)
+    return _cvtss_sh(f, _MM_FROUND_TO_NEAREST_INT);
+#else
+    _Float16 h = (_Float16)f;  // round-to-nearest-even, matches numpy
+    uint16_t out;
+    std::memcpy(&out, &h, sizeof(out));
+    return out;
+#endif
+}
+
+static inline float f16_to_f32(uint16_t u) {
+#if defined(__F16C__)
+    return _cvtsh_ss(u);
+#else
+    _Float16 h;
+    std::memcpy(&h, &u, sizeof(h));
+    return (float)h;
+#endif
+}
+
+extern "C" {
+
+// returns 0 on success
+int quantize_sym(const float* w, int64_t n_in, int64_t n_out, int bs,
+                 int bits, uint8_t* data, uint16_t* scales) {
+    if (bits != 4 && bits != 8) return 1;
+    if (n_in % bs != 0) return 2;  // caller pads (core.py::_to_blocks)
+    const int64_t n_blocks = n_in / bs;
+    const int qmax = 1 << (bits - 1);
+    const int qhi = 2 * qmax - 1;
+    const int half = bs / 2;
+
+#pragma omp parallel for schedule(static)
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        const float* blk = w + b * bs * n_out;
+        for (int64_t o = 0; o < n_out; ++o) {
+            // signed value with max magnitude (first occurrence wins,
+            // matching jnp.argmax over |x|)
+            float smax = blk[o];
+            float amax = std::fabs(smax);
+            for (int j = 1; j < bs; ++j) {
+                const float x = blk[(int64_t)j * n_out + o];
+                const float a = std::fabs(x);
+                if (a > amax) { amax = a; smax = x; }
+            }
+            // match the f32 arithmetic of the jnp codec exactly
+            const float d = smax / (float)(-qmax);
+            // scales round-trip through fp16 storage like SCALE_DTYPE
+            const uint16_t d16 = f32_to_f16(d);
+            scales[b * n_out + o] = d16;
+            const float inv = (d == 0.0f) ? 0.0f : 1.0f / d;
+            if (bits == 8) {
+                for (int j = 0; j < bs; ++j) {
+                    const float x = blk[(int64_t)j * n_out + o];
+                    float q = nearbyintf(x * inv) + (float)qmax;
+                    if (q < 0.f) q = 0.f;
+                    if (q > (float)qhi) q = (float)qhi;
+                    data[(b * bs + j) * n_out + o] = (uint8_t)q;
+                }
+            } else {
+                for (int j = 0; j < half; ++j) {
+                    const float xl = blk[(int64_t)j * n_out + o];
+                    const float xh = blk[(int64_t)(j + half) * n_out + o];
+                    float ql = nearbyintf(xl * inv) + (float)qmax;
+                    float qh = nearbyintf(xh * inv) + (float)qmax;
+                    if (ql < 0.f) ql = 0.f; if (ql > (float)qhi) ql = (float)qhi;
+                    if (qh < 0.f) qh = 0.f; if (qh > (float)qhi) qh = (float)qhi;
+                    data[(b * half + j) * n_out + o] =
+                        (uint8_t)ql | ((uint8_t)qh << 4);
+                }
+            }
+        }
+    }
+    return 0;
+}
+
+// dequantize for verification / host-side use
+int dequantize_sym(const uint8_t* data, const uint16_t* scales,
+                   int64_t n_in, int64_t n_out, int bs, int bits, float* out) {
+    if (bits != 4 && bits != 8) return 1;
+    const int64_t n_blocks = n_in / bs;
+    const int qmax = 1 << (bits - 1);
+    const int half = bs / 2;
+#pragma omp parallel for schedule(static)
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        for (int64_t o = 0; o < n_out; ++o) {
+            const float d = f16_to_f32(scales[b * n_out + o]);
+            if (bits == 8) {
+                for (int j = 0; j < bs; ++j) {
+                    const int64_t idx = (b * bs + j) * n_out + o;
+                    out[idx] = ((int)data[idx] - qmax) * d;
+                }
+            } else {
+                for (int j = 0; j < half; ++j) {
+                    const uint8_t byte = data[(b * half + j) * n_out + o];
+                    out[(b * bs + j) * n_out + o] =
+                        ((int)(byte & 0x0F) - qmax) * d;
+                    out[(b * bs + j + half) * n_out + o] =
+                        ((int)(byte >> 4) - qmax) * d;
+                }
+            }
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

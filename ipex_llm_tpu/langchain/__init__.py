@@ -1,0 +1,9 @@
+"""LangChain adapters (reference langchain/llms/transformersllm.py:61).
+
+Import-guarded: langchain is an optional dependency; the classes raise a
+clear error at construction when it is absent.
+"""
+
+from ipex_llm_tpu.langchain.llms import TransformersLLM, TransformersPipelineLLM
+
+__all__ = ["TransformersLLM", "TransformersPipelineLLM"]

@@ -1,0 +1,91 @@
+"""LangChain LLM wrappers over the TPU model.
+
+Reference counterpart: ``TransformersLLM`` (reference
+langchain/llms/transformersllm.py:61 — from_model_id / from_model_id_low_bit
+classmethods, `_call` running HF generate).  The adapter keeps that exact
+call shape; when langchain isn't installed the class still works as a plain
+callable LLM (duck-typed), so the adapter logic is testable without the
+dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+try:  # langchain >= 0.1 layout, else legacy, else stub
+    from langchain_core.language_models.llms import LLM as _LCBase
+except ImportError:
+    try:
+        from langchain.llms.base import LLM as _LCBase
+    except ImportError:
+        class _LCBase:  # minimal duck-typed stand-in
+            def __init__(self, **kwargs):
+                for k, v in kwargs.items():
+                    object.__setattr__(self, k, v)
+
+            def __call__(self, prompt: str, stop=None, **kw) -> str:
+                return self._call(prompt, stop=stop, **kw)
+
+
+class TransformersLLM(_LCBase):
+    """LangChain LLM backed by ipex_llm_tpu (reference transformersllm.py:61)."""
+
+    model: Any = None
+    tokenizer: Any = None
+    model_kwargs: Optional[dict] = None
+    streaming: bool = False
+
+    @classmethod
+    def from_model_id(cls, model_id: str, model_kwargs: dict | None = None,
+                      **kwargs):
+        from transformers import AutoTokenizer
+
+        from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+        mk = dict(model_kwargs or {})
+        mk.setdefault("load_in_4bit", True)
+        model = AutoModelForCausalLM.from_pretrained(model_id, **mk)
+        tokenizer = AutoTokenizer.from_pretrained(model_id,
+                                                  trust_remote_code=True)
+        return cls(model=model, tokenizer=tokenizer, model_kwargs=mk, **kwargs)
+
+    @classmethod
+    def from_model_id_low_bit(cls, model_id: str,
+                              model_kwargs: dict | None = None, **kwargs):
+        from transformers import AutoTokenizer
+
+        from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.load_low_bit(model_id)
+        tokenizer = AutoTokenizer.from_pretrained(model_id,
+                                                  trust_remote_code=True)
+        return cls(model=model, tokenizer=tokenizer,
+                   model_kwargs=model_kwargs, **kwargs)
+
+    @property
+    def _llm_type(self) -> str:
+        return "ipex_llm_tpu_transformers"
+
+    def _call(self, prompt: str, stop=None, run_manager=None, **kwargs) -> str:
+        import numpy as np
+
+        ids = np.asarray(self.tokenizer(prompt)["input_ids"], np.int32)
+        out = self.model.generate(
+            ids, max_new_tokens=int(kwargs.get("max_new_tokens", 128))
+        )
+        text = self.tokenizer.decode(
+            out[0][len(ids):], skip_special_tokens=True
+        )
+        if stop:
+            cuts = [text.find(s) for s in stop if text.find(s) >= 0]
+            if cuts:
+                text = text[: min(cuts)]
+        return text
+
+
+class TransformersPipelineLLM(TransformersLLM):
+    """Pipeline-flavored alias (reference transformersllm.py sibling class)."""
+
+    @property
+    def _llm_type(self) -> str:
+        return "ipex_llm_tpu_transformers_pipeline"

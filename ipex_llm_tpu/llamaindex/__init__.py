@@ -1,0 +1,5 @@
+"""LlamaIndex adapter (reference llamaindex/llms/bigdlllm.py:90 ``IpexLLM``)."""
+
+from ipex_llm_tpu.llamaindex.llms import IpexLLM
+
+__all__ = ["IpexLLM"]

@@ -1,0 +1,103 @@
+"""LlamaIndex ``CustomLLM`` wrapper (reference llamaindex/llms/bigdlllm.py:90).
+
+Import-guarded like the langchain adapter: with llama_index absent the class
+degrades to a plain object exposing ``complete``/``stream_complete``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+try:
+    from llama_index.core.llms import (  # type: ignore
+        CustomLLM,
+        CompletionResponse,
+        LLMMetadata,
+    )
+    from llama_index.core.llms.callbacks import llm_completion_callback
+    _HAVE_LI = True
+except ImportError:
+    _HAVE_LI = False
+
+    class CustomLLM:  # duck-typed stand-in
+        pass
+
+    class CompletionResponse:
+        def __init__(self, text: str, delta: str | None = None):
+            self.text = text
+            self.delta = delta
+
+    class LLMMetadata:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def llm_completion_callback():
+        def deco(fn):
+            return fn
+        return deco
+
+
+class IpexLLM(CustomLLM):
+    """reference bigdlllm.py:90 ``IpexLLM(CustomLLM)`` equivalent."""
+
+    context_window: int = 4096
+    max_new_tokens: int = 128
+
+    def __init__(self, model: Any = None, tokenizer: Any = None,
+                 model_name: str | None = None,
+                 load_in_low_bit: str = "sym_int4",
+                 context_window: int = 4096, max_new_tokens: int = 128,
+                 **kwargs):
+        if _HAVE_LI:
+            super().__init__(**kwargs)
+        if model is None and model_name is not None:
+            from transformers import AutoTokenizer
+
+            from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+            model = AutoModelForCausalLM.from_pretrained(
+                model_name, load_in_low_bit=load_in_low_bit
+            )
+            tokenizer = AutoTokenizer.from_pretrained(model_name,
+                                                      trust_remote_code=True)
+        object.__setattr__(self, "_model", model)
+        object.__setattr__(self, "_tokenizer", tokenizer)
+        object.__setattr__(self, "context_window", context_window)
+        object.__setattr__(self, "max_new_tokens", max_new_tokens)
+
+    @classmethod
+    def from_model_id(cls, model_name: str, **kwargs) -> "IpexLLM":
+        return cls(model_name=model_name, **kwargs)
+
+    @property
+    def metadata(self) -> LLMMetadata:
+        return LLMMetadata(
+            context_window=self.context_window,
+            num_output=self.max_new_tokens,
+            model_name="ipex_llm_tpu",
+        )
+
+    def _generate_text(self, prompt: str, **kwargs) -> str:
+        import numpy as np
+
+        ids = np.asarray(self._tokenizer(prompt)["input_ids"], np.int32)
+        out = self._model.generate(
+            ids, max_new_tokens=int(kwargs.get("max_new_tokens",
+                                               self.max_new_tokens))
+        )
+        return self._tokenizer.decode(out[0][len(ids):],
+                                      skip_special_tokens=True)
+
+    @llm_completion_callback()
+    def complete(self, prompt: str, formatted: bool = False,
+                 **kwargs) -> CompletionResponse:
+        return CompletionResponse(text=self._generate_text(prompt, **kwargs))
+
+    @llm_completion_callback()
+    def stream_complete(self, prompt: str, formatted: bool = False, **kwargs):
+        text = self._generate_text(prompt, **kwargs)
+        acc = ""
+        for piece in text.split(" "):
+            acc += piece + " "
+            yield CompletionResponse(text=acc, delta=piece + " ")

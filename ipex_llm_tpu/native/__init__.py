@@ -1,0 +1,16 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference counterpart: the prebuilt ggml C library + ctypes bindings
+(reference ggml/model/llama/llama_cpp.py:71-109, low_bit_linear.py:106-279).
+Here the native quantizer builds from source on first use (g++ is in the
+image; no wheel needed) and the pure-jnp codec remains the fallback and the
+correctness oracle — the native path must be bit-exact with it.
+"""
+
+from ipex_llm_tpu.native.quantizer import (
+    available,
+    build,
+    quantize_sym_native,
+)
+
+__all__ = ["available", "build", "quantize_sym_native"]

@@ -1,0 +1,100 @@
+"""ctypes loader + builder for the C++ block quantizer (csrc/quantize.cpp)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None | bool = None  # None=untried, False=unavailable
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc",
+                    "quantize.cpp")
+_OUT = os.path.join(os.path.dirname(__file__), "_libquantize.so")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the shared library (g++ -O3 -march=native -fopenmp)."""
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_OUT) and not force and (
+        os.path.getmtime(_OUT) >= os.path.getmtime(src)
+    ):
+        return _OUT
+    cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+           src, "-o", _OUT]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+    return _OUT
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            path = build()
+            if path is None:
+                _LIB = False
+            else:
+                try:
+                    lib = ctypes.CDLL(path)
+                    lib.quantize_sym.restype = ctypes.c_int
+                    lib.quantize_sym.argtypes = [
+                        ctypes.POINTER(ctypes.c_float),
+                        ctypes.c_int64, ctypes.c_int64,
+                        ctypes.c_int, ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_uint8),
+                        ctypes.POINTER(ctypes.c_uint16),
+                    ]
+                    lib.dequantize_sym.restype = ctypes.c_int
+                    lib.dequantize_sym.argtypes = [
+                        ctypes.POINTER(ctypes.c_uint8),
+                        ctypes.POINTER(ctypes.c_uint16),
+                        ctypes.c_int64, ctypes.c_int64,
+                        ctypes.c_int, ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_float),
+                    ]
+                    _LIB = lib
+                except OSError:
+                    _LIB = False
+        return _LIB or None
+
+
+def available() -> bool:
+    if os.environ.get("IPEX_LLM_TPU_DISABLE_NATIVE", "0") == "1":
+        return False
+    return _load() is not None
+
+
+def quantize_sym_native(w: np.ndarray, bits: int, bs: int):
+    """Bit-exact native counterpart of core._quant_int_sym for fp32 numpy
+    input.  Returns (data uint8, scales float16) or None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    w = np.ascontiguousarray(w, np.float32)
+    n_in, n_out = w.shape
+    pad = (-n_in) % bs
+    if pad:
+        w = np.concatenate([w, np.zeros((pad, n_out), np.float32)], axis=0)
+        n_in += pad
+    n_blocks = n_in // bs
+    data_rows = n_in // 2 if bits == 4 else n_in
+    data = np.empty((data_rows, n_out), np.uint8)
+    scales = np.empty((n_blocks, n_out), np.uint16)
+    rc = lib.quantize_sym(
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_in, n_out, bs, bits,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+    )
+    if rc != 0:
+        return None
+    return data, scales.view(np.float16)

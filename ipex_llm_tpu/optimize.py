@@ -24,9 +24,14 @@ def optimize_model(model: Any, low_bit: str = "sym_int4", **kwargs):
     """Convert a loaded HF torch model (or passthrough an already-converted
     TPU model) to a quantized TPU model.
 
-    kwargs accepted for reference parity: ``optimize_llm``, ``modules_to_not_convert``
-    (unsupported modules keep bf16), ``cpu_embedding``.
+    Reference-parity kwargs: ``modules_to_not_convert`` (only ``lm_head``
+    meaningfully maps here — the merged-slot design has no per-module
+    granularity; other entries warn), ``cpu_embedding`` /
+    ``embedding_qtype`` (low-bit table, see ops/embedding.py),
+    ``optimize_llm`` (accepted; the optimized path is the only path).
     """
+    import warnings
+
     from ipex_llm_tpu.models.build import build_params
     from ipex_llm_tpu.models.families import get_family
     from ipex_llm_tpu.transformers.model import TPUModelForCausalLM
@@ -44,13 +49,31 @@ def optimize_model(model: Any, low_bit: str = "sym_int4", **kwargs):
     cfg = family.to_config(hf_config)
     state = model.state_dict()
 
+    lm_head_qtype = None
+    skip = list(kwargs.pop("modules_to_not_convert", []) or [])
+    if "lm_head" in skip:
+        lm_head_qtype = "bf16"
+        skip.remove("lm_head")
+    if skip:
+        warnings.warn(
+            f"modules_to_not_convert={skip} has no per-module equivalent in "
+            "the merged-slot decoder; these stay quantized"
+        )
+    embedding_qtype = kwargs.pop("embedding_qtype", None)
+    if kwargs.pop("cpu_embedding", False):
+        embedding_qtype = embedding_qtype or "sym_int8"
+
     def get(name: str) -> np.ndarray:
         return state[name].detach().to("cpu").float().numpy()
 
     def has(name: str) -> bool:
         return name in state
 
-    params = build_params(cfg, family.scheme, get, has, qtype=low_bit)
+    params = build_params(
+        cfg, family.scheme, get, has, qtype=low_bit,
+        lm_head_qtype=lm_head_qtype, moe_scheme=family.moe,
+        embedding_qtype=embedding_qtype, qkv_transform=family.qkv_transform,
+    )
     return TPUModelForCausalLM(cfg, params, hf_config, low_bit)
 
 

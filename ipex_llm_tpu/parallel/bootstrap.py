@@ -1,0 +1,65 @@
+"""Multi-host bootstrap + health checks.
+
+Reference counterpart: ``init_pipeline_parallel`` →
+``dist.init_process_group('ccl')`` (reference pipeline_parallel.py:108-112)
+and the world-size asserts (model.py:356-358).  On TPU pods the equivalent
+is ``jax.distributed.initialize`` (coordinator address from the environment
+on Cloud TPU) — afterwards ``jax.devices()`` spans every host and the same
+mesh/sharding code runs unchanged over ICI+DCN.
+
+The reference has no failure detection at all (SURVEY.md §5); ``health``
+gives serving a cheap liveness probe across the slice.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def init_distributed(coordinator: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
+    """Initialize multi-host JAX.  No-ops on a single host; returns whether
+    a multi-host runtime is active."""
+    import jax
+
+    if num_processes is None:
+        num_processes = int(os.environ.get("IPEX_LLM_TPU_NUM_PROCESSES", "0"))
+    if num_processes and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator
+            or os.environ.get("IPEX_LLM_TPU_COORDINATOR"),
+            num_processes=num_processes,
+            process_id=process_id
+            if process_id is not None
+            else int(os.environ.get("IPEX_LLM_TPU_PROCESS_ID", "0")),
+        )
+        return True
+    # Cloud TPU pods auto-discover via the metadata server
+    if os.environ.get("TPU_WORKER_HOSTNAMES"):
+        import jax
+
+        jax.distributed.initialize()
+        return True
+    return False
+
+
+def health() -> dict:
+    """Cheap slice-liveness probe: one tiny collective over every device."""
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    try:
+        ones = [jax.device_put(jnp.ones(()), d) for d in devices]
+        total = sum(float(x) for x in ones)
+        ok = int(total) == len(devices)
+    except Exception as e:  # a dead chip raises on transfer
+        return {"ok": False, "error": f"{type(e).__name__}: {e}",
+                "n_devices": len(devices)}
+    return {
+        "ok": ok,
+        "n_devices": len(devices),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }

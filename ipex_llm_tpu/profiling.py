@@ -1,0 +1,67 @@
+"""Tracing / profiling hooks.
+
+Reference counterpart: the BenchmarkWrapper timing instrumentation
+(reference utils/benchmark_util_*.py:353 — first-token vs rest latency) and
+the NPU builder's profile flag.  TPU-native: ``jax.profiler`` traces (for
+xprof/tensorboard) plus a lightweight step-timer that the generate loop and
+serving engine already feed (first_cost / rest_cost_mean attributes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None = None):
+    """Capture a jax.profiler trace (view with tensorboard/xprof).
+
+    Enabled explicitly or via IPEX_LLM_TPU_PROFILE=<dir>.
+    """
+    import jax
+
+    log_dir = log_dir or os.environ.get("IPEX_LLM_TPU_PROFILE")
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class StepTimer:
+    """TTFT + per-token latency accumulator (BenchmarkWrapper metrics)."""
+
+    first_token_s: float | None = None
+    token_times: list = field(default_factory=list)
+    _t0: float | None = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def tick(self):
+        now = time.perf_counter()
+        if self.first_token_s is None:
+            self.first_token_s = now - self._t0
+        else:
+            self.token_times.append(now - self._t0)
+        self._t0 = now
+
+    @property
+    def rest_cost_mean(self) -> float:
+        return sum(self.token_times) / max(len(self.token_times), 1)
+
+    def summary(self) -> dict:
+        return {
+            "first_token_s": self.first_token_s,
+            "rest_token_s": self.rest_cost_mean,
+            "decode_tok_s": (1.0 / self.rest_cost_mean
+                             if self.token_times else 0.0),
+        }

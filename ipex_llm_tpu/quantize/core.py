@@ -282,7 +282,30 @@ def quantize(w: Any, qtype: str, block_size: int | None = None) -> QTensor:
     Reference counterpart: ``FP4Params.quantize`` → ``ggml_convert_qtype``
     (low_bit_linear.py:370,106); here a pure-jnp jitted codec.
     """
+    import numpy as _np
+
     info = qtypes.resolve(qtype)
+    if (
+        isinstance(w, _np.ndarray)
+        and info.kind == "int_sym"
+        and int(info.bits) in (4, 8)
+    ):
+        # C++ quantizer (the ggml CPU quantizer equivalent, native/): same
+        # math, fraction of the load-time cost; falls through when the
+        # library is unavailable
+        from ipex_llm_tpu.native import quantizer as _nq
+
+        if _nq.available():
+            shape = tuple(w.shape)
+            bs = block_size or info.block_size
+            out = _nq.quantize_sym_native(
+                _np.asarray(w, _np.float32), int(info.bits), bs
+            )
+            if out is not None:
+                data, scales = out
+                return QTensor(jnp.asarray(data), jnp.asarray(scales), None,
+                               info.name, shape, bs)
+
     w = _as_jnp_f32(w)
     if w.ndim != 2:
         raise ValueError(f"expected 2-D weight, got shape {w.shape}")

@@ -1,0 +1,259 @@
+"""Structured output: JSON-constrained decoding.
+
+Reference counterpart: the xgrammar logits-processor shim (reference
+xgrammar.py:21-47) which delegates grammar compilation to the external
+``xgrammar`` wheel.  That wheel doesn't exist in this environment, so this
+is a self-contained implementation: an incremental JSON pushdown validator
+plus top-k filtered decoding — each step takes the highest-logit token whose
+text keeps the output a valid JSON prefix, guaranteeing the final text
+parses.  (Schema enforcement beyond well-formed JSON objects is future
+work; the reference's shim is similarly scoped to what xgrammar compiles.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_WS = " \t\n\r"
+_DIGITS = "0123456789"
+
+
+@dataclass
+class JsonValidator:
+    """Incremental validator: feed characters, stays in a valid-prefix state.
+
+    stack entries: 'o' in-object (expect key or '}'), 'k' after key (expect
+    ':'), 'v' expect value inside object, 'a' in-array, 's' in-string,
+    'e' escape, 'n' in-number, 'l:<word>:<pos>' in-literal.
+    """
+
+    stack: list = field(default_factory=lambda: ["start"])
+    done: bool = False
+    numbuf: str = ""
+
+    def clone(self) -> "JsonValidator":
+        return JsonValidator(stack=list(self.stack), done=self.done,
+                             numbuf=self.numbuf)
+
+    _NUM_RE = __import__("re").compile(
+        r"-?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?$"
+    )
+
+    # -- helpers ------------------------------------------------------------
+
+    def _push_value(self, c: str) -> bool:
+        """Start a value with char c (top of stack expects a value)."""
+        if c == "{":
+            self.stack.append("obj0")       # expect key or }
+            return True
+        if c == "[":
+            self.stack.append("arr0")       # expect value or ]
+            return True
+        if c == '"':
+            self.stack.append("vstr")
+            return True
+        if c in "-" + _DIGITS:
+            self.stack.append("num")
+            self.numbuf = c
+            return True
+        for lit in ("true", "false", "null"):
+            if c == lit[0]:
+                self.stack.append(f"lit:{lit}:1")
+                return True
+        return False
+
+    def _end_value(self):
+        """A value just finished; fix up the container above."""
+        top = self.stack[-1] if self.stack else None
+        if top == "start":
+            self.stack.pop()
+            self.done = True
+        elif top == "objv":                  # value inside object done
+            self.stack[-1] = "obj_after"
+        elif top in ("arr0", "arr_elem"):
+            self.stack[-1] = "arr_after"
+
+    def feed(self, text: str) -> bool:
+        """Consume text; returns False (and poisons state) on violation."""
+        for c in text:
+            if not self._feed_char(c):
+                self.stack = ["DEAD"]
+                return False
+        return True
+
+    def _feed_char(self, c: str) -> bool:  # noqa: C901 (a DFA is a DFA)
+        if self.done:
+            return c in _WS
+        top = self.stack[-1]
+
+        if top == "DEAD":
+            return False
+        if top in ("vstr", "kstr"):
+            if c == "\\":
+                self.stack.append("esc")
+            elif c == '"':
+                self.stack.pop()
+                if top == "kstr":
+                    self.stack[-1] = "objk_done"   # expect ':'
+                else:
+                    self._end_value()
+            return True
+        if top == "esc":
+            self.stack.pop()
+            return True
+        if top == "num":
+            if c in _DIGITS + ".eE+-":
+                self.numbuf += c
+                # reject impossible prefixes early (e.g. leading zeros)
+                probe = self.numbuf.rstrip("eE+-.")
+                if probe and not self._num_prefix_ok(self.numbuf):
+                    return False
+                return True
+            if self._NUM_RE.match(self.numbuf) is None:
+                return False  # e.g. "5e" or "1." with no digits
+            self.stack.pop()
+            self._end_value()
+            return self._feed_char(c) if not self.done else (c in _WS)
+        if top.startswith("lit:"):
+            _, word, pos = top.split(":")
+            pos = int(pos)
+            if pos < len(word) and c == word[pos]:
+                if pos + 1 == len(word):
+                    self.stack.pop()
+                    self._end_value()
+                else:
+                    self.stack[-1] = f"lit:{word}:{pos + 1}"
+                return True
+            return False
+
+        if c in _WS:
+            return True
+
+        if top == "start":
+            return self._push_value(c)
+        if top == "obj0":                    # { seen: key or }
+            if c == '"':
+                self.stack[-1] = "objk"
+                self.stack.append("kstr")
+                return True
+            if c == "}":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return False
+        if top == "objk_done":               # key string closed: expect ':'
+            if c == ":":
+                self.stack[-1] = "objv"
+                return self._maybe_value_next()
+            return False
+        if top == "objv":                    # expect a value
+            return self._push_value(c)
+        if top == "obj_after":               # value done: ',' or '}'
+            if c == ",":
+                self.stack[-1] = "obj0"
+                return True
+            if c == "}":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return False
+        if top == "arr0":                    # [ seen: value or ]
+            if c == "]":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return self._push_value(c)
+        if top == "arr_elem":                # after ',': value required
+            return self._push_value(c)
+        if top == "arr_after":               # ',' or ']'
+            if c == ",":
+                self.stack[-1] = "arr_elem"
+                return True
+            if c == "]":
+                self.stack.pop()
+                self._end_value()
+                return True
+            return False
+        return False
+
+    def _maybe_value_next(self) -> bool:
+        return True
+
+    @staticmethod
+    def _num_prefix_ok(s: str) -> bool:
+        """Can ``s`` be extended to a valid JSON number?"""
+        import re
+
+        return re.match(
+            r"-?(0|[1-9]\d*)?(\.\d*)?([eE][+-]?\d*)?$", s
+        ) is not None and not re.match(r"-?0\d", s)
+
+    def could_end(self) -> bool:
+        """True if the text so far, possibly after closing the current
+        number, is complete JSON."""
+        if self.done:
+            return True
+        if self.stack and self.stack[-1] == "num" and len(self.stack) == 2 \
+                and self.stack[0] == "start":
+            return True
+        return False
+
+
+def generate_json(
+    cfg,
+    params,
+    tokenizer,
+    prompt_ids: list[int],
+    max_new_tokens: int = 256,
+    top_candidates: int = 64,
+) -> str:
+    """Greedy JSON-constrained decoding: each step picks the highest-logit
+    token whose text keeps the output a valid JSON prefix."""
+    from ipex_llm_tpu import kv as kv_mod
+    from ipex_llm_tpu.generation import _round_up, prefill_step
+    from ipex_llm_tpu.models.decoder import decoder_forward
+
+    n_p = len(prompt_ids)
+    tpad = _round_up(n_p, 16)
+    toks = np.zeros((1, tpad), np.int32)
+    toks[0, tpad - n_p:] = prompt_ids
+    cap = tpad + max_new_tokens + 8
+    cache = kv_mod.make_cache("normal", cfg.num_layers, 1, cap,
+                              cfg.num_kv_heads, cfg.head_dim)
+    logits, cache = prefill_step(
+        cfg, params, cache, jnp.asarray(toks), jnp.asarray([n_p], np.int32)
+    )
+    kv_start = jnp.asarray([tpad - n_p], np.int32)
+
+    validator = JsonValidator()
+    text = ""
+    out_ids: list[int] = []
+    for step in range(max_new_tokens):
+        lg = np.asarray(logits, np.float32).reshape(-1)
+        order = np.argsort(-lg)[:top_candidates]
+        chosen = None
+        for tid in order:
+            piece = tokenizer.decode([int(tid)])
+            v2 = validator.clone()
+            if piece and v2.feed(piece):
+                chosen = int(tid)
+                validator = v2
+                break
+        if chosen is None:
+            break  # no valid continuation in the candidate set
+        out_ids.append(chosen)
+        text += tokenizer.decode([chosen])
+        if validator.done:
+            break
+        pos = jnp.asarray([[n_p + step]], jnp.int32)
+        tok = jnp.asarray([[chosen]], jnp.int32)
+        logits, cache = decoder_forward(
+            cfg, params, tok, cache, pos, kv_start=kv_start,
+            last_token_only=True,
+        )
+    return text

@@ -103,6 +103,17 @@ class TPUModelForCausalLM:
         family = get_family(hf_config.get("model_type", "llama"))
         cfg = family.to_config(hf_config)
         reader = CheckpointReader(path)
+        qc = hf_config.get("quantization_config")
+        if qc and qc.get("quant_method") in ("gptq", "awq"):
+            # GPTQ/AWQ interop (reference model.py:251-295): dequantize the
+            # packed checkpoint on read, requantize into QTensors
+            from ipex_llm_tpu.transformers.quant_import import (
+                QuantizedCheckpointAdapter,
+            )
+
+            reader = QuantizedCheckpointAdapter(reader, qc)
+            if qtype == "bf16":  # keep a 4-bit checkpoint 4-bit by default
+                qtype = "asym_int4"
         params = build_params(
             cfg, family.scheme, reader.get, reader.has,
             qtype=qtype, mixed_precision=mixed_precision,

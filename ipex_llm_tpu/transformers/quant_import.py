@@ -1,0 +1,113 @@
+"""GPTQ / AWQ checkpoint import.
+
+Reference counterparts: ``convert_gptq`` (reference convert.py:382-456 —
+int32-packed 4-bit unpack, ``g_idx`` act-order remap) and the AWQ repack
+(transformers/awq/).  Both formats store, per linear:
+
+- GPTQ:  qweight [in/8, out] int32 (8 nibbles per word along IN, sequential
+  order), qzeros [groups, out/8] int32, scales [groups, out] fp16,
+  g_idx [in] (group of each input row; permuted when desc_act=True).
+  value = (q - z - 1) * s   (the GPTQ +1 zero-point convention)
+- AWQ (WQLinear_GEMM): qweight [in, out/8] int32 (8 nibbles per word along
+  OUT in the interleave order 0,2,4,6,1,3,5,7), qzeros [groups, out/8],
+  scales [groups, out] fp16.  value = (q - z) * s.
+
+The adapter exposes the same ``get/has`` surface as CheckpointReader but
+synthesizes plain ``*.weight`` tensors by dequantizing on read; the build
+pipeline then requantizes to the requested qtype on a 32-wide block grid —
+a strictly finer grid than the 128-wide GPTQ/AWQ groups, so the round-trip
+error is bounded by one 4-bit quantization step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_AWQ_ORDER = np.array([0, 2, 4, 6, 1, 3, 5, 7])
+
+
+def _unpack_rows(x: np.ndarray) -> np.ndarray:
+    """int32 [a, b] -> uint8 [a*8, b]: 8 sequential nibbles per word (GPTQ
+    packing along the first axis)."""
+    a, b = x.shape
+    xv = x.view(np.uint32)
+    shifts = (np.arange(8, dtype=np.uint32) * 4)[None, :, None]
+    codes = (xv[:, None, :] >> shifts) & 0xF
+    return codes.reshape(a * 8, b).astype(np.uint8)
+
+
+def _unpack_cols(x: np.ndarray, order=None) -> np.ndarray:
+    """int32 [a, b] -> uint8 [a, b*8]: 8 nibbles per word along the second
+    axis, optionally in AWQ's interleave order."""
+    a, b = x.shape
+    xv = x.view(np.uint32)
+    shifts = (np.arange(8, dtype=np.uint32) * 4)[None, None, :]
+    codes = ((xv[:, :, None] >> shifts) & 0xF).astype(np.uint8)  # [a,b,8]
+    if order is not None:
+        inv = np.argsort(order)
+        codes = codes[:, :, inv]
+    return codes.reshape(a, b * 8)
+
+
+def dequant_gptq(qweight, qzeros, scales, g_idx=None) -> np.ndarray:
+    """Returns the fp32 weight in HF layout [out, in]."""
+    q = _unpack_rows(np.ascontiguousarray(qweight))          # [in, out]
+    z = _unpack_cols(np.ascontiguousarray(qzeros))           # [groups, out]
+    s = scales.astype(np.float32)                            # [groups, out]
+    n_in = q.shape[0]
+    if g_idx is None:
+        group_size = n_in // s.shape[0]
+        g = np.arange(n_in) // group_size
+    else:
+        g = np.asarray(g_idx, np.int64)
+    w = (q.astype(np.float32) - (z[g].astype(np.float32) + 1.0)) * s[g]
+    return np.ascontiguousarray(w.T)                         # [out, in]
+
+
+def dequant_awq(qweight, qzeros, scales) -> np.ndarray:
+    """Returns the fp32 weight in HF layout [out, in]."""
+    q = _unpack_cols(np.ascontiguousarray(qweight), _AWQ_ORDER)  # [in, out]
+    z = _unpack_cols(np.ascontiguousarray(qzeros), _AWQ_ORDER)   # [groups, out]
+    s = scales.astype(np.float32)
+    group_size = q.shape[0] // s.shape[0]
+    g = np.arange(q.shape[0]) // group_size
+    w = (q.astype(np.float32) - z[g].astype(np.float32)) * s[g]
+    return np.ascontiguousarray(w.T)
+
+
+class QuantizedCheckpointAdapter:
+    """CheckpointReader facade over a GPTQ/AWQ checkpoint: ``get`` on a
+    ``*.weight`` name dequantizes the packed tensors behind it."""
+
+    def __init__(self, reader, quant_config: dict):
+        self.reader = reader
+        method = quant_config.get("quant_method", "gptq")
+        if method not in ("gptq", "awq"):
+            raise NotImplementedError(f"quant_method {method!r}")
+        bits = quant_config.get("bits", quant_config.get("w_bit", 4))
+        if bits != 4:
+            raise NotImplementedError(f"{method} bits={bits} (only 4-bit)")
+        self.method = method
+
+    def _stem(self, name: str) -> str | None:
+        if name.endswith(".weight"):
+            stem = name[: -len(".weight")]
+            if self.reader.has(stem + ".qweight"):
+                return stem
+        return None
+
+    def has(self, name: str) -> bool:
+        return self.reader.has(name) or self._stem(name) is not None
+
+    def get(self, name: str) -> np.ndarray:
+        stem = self._stem(name)
+        if stem is None:
+            return self.reader.get(name)
+        qweight = self.reader.get(stem + ".qweight")
+        qzeros = self.reader.get(stem + ".qzeros")
+        scales = self.reader.get(stem + ".scales")
+        if self.method == "gptq":
+            g_idx = (self.reader.get(stem + ".g_idx")
+                     if self.reader.has(stem + ".g_idx") else None)
+            return dequant_gptq(qweight, qzeros, scales, g_idx)
+        return dequant_awq(qweight, qzeros, scales)

@@ -1,0 +1,9 @@
+"""Shared utilities (reference utils/common: log4Error, LazyImport)."""
+
+from ipex_llm_tpu.utils.common import (
+    LazyImport,
+    invalidInputError,
+    invalidOperationError,
+)
+
+__all__ = ["LazyImport", "invalidInputError", "invalidOperationError"]

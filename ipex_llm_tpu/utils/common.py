@@ -1,0 +1,50 @@
+"""Error helpers + lazy imports.
+
+Reference counterparts: ``invalidInputError`` (reference
+utils/common/log4Error.py — logs a fix suggestion, then raises) and
+``LazyImport`` (utils/lazy_load_torch.py pattern).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import Any
+
+log = logging.getLogger("ipex_llm_tpu")
+
+
+def invalidInputError(condition: bool, errMsg: str,
+                      fixMsg: str | None = None) -> None:
+    """Raise RuntimeError with a logged fix suggestion unless condition."""
+    if not condition:
+        if fixMsg:
+            log.error("Possible fix: %s", fixMsg)
+        raise RuntimeError(errMsg)
+
+
+def invalidOperationError(condition: bool, errMsg: str,
+                          fixMsg: str | None = None,
+                          cause: BaseException | None = None) -> None:
+    if not condition:
+        if fixMsg:
+            log.error("Possible fix: %s", fixMsg)
+        if cause is not None:
+            raise RuntimeError(errMsg) from cause
+        raise RuntimeError(errMsg)
+
+
+class LazyImport:
+    """Defer a module import until first attribute access."""
+
+    def __init__(self, module_name: str):
+        self._module_name = module_name
+        self._module: Any = None
+
+    def _load(self):
+        if self._module is None:
+            self._module = importlib.import_module(self._module_name)
+        return self._module
+
+    def __getattr__(self, name: str):
+        return getattr(self._load(), name)

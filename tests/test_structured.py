@@ -1,0 +1,71 @@
+"""JSON-constrained decoding (reference xgrammar.py shim equivalent)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.structured import JsonValidator, generate_json
+from tests.test_decoder import rand_params, tiny_cfg
+
+
+@pytest.mark.parametrize("text", [
+    '{"a": 1}',
+    '{"a": [1, 2.5, -3e2], "b": {"c": null}}',
+    '[true, false, "x\\"y", {}]',
+    '  {"k" : "v" }  ',
+    '"just a string"',
+    "-12.5e-3",
+])
+def test_validator_accepts_valid(text):
+    v = JsonValidator()
+    assert v.feed(text), text
+    json.loads(text)  # sanity: python agrees
+    assert v.done or v.could_end()
+
+
+@pytest.mark.parametrize("text", [
+    '{"a": 1,}X',
+    "{a: 1}",
+    '{"a" 1}',
+    "[1, ]",        # trailing comma then close
+    '{"a": tru0}',
+    "}",
+])
+def test_validator_rejects_invalid(text):
+    v = JsonValidator()
+    ok = v.feed(text)
+    assert not (ok and v.done), text
+
+
+def test_validator_prefixes_stay_valid():
+    v = JsonValidator()
+    for c in '{"key": [1, {"x": "y"}':
+        assert v.feed(c), c
+    assert not v.done
+
+
+def test_generate_json_produces_valid_json():
+    cfg = tiny_cfg(vocab_size=128, hidden_size=32, intermediate_size=64,
+                   num_heads=4, num_kv_heads=2, head_dim=8)
+    params = rand_params(cfg, qtype="bf16")
+
+    class CharTok:
+        """Token id i -> one printable char (subset covers JSON)."""
+
+        chars = (' {}[]:,"0123456789.-+eE'
+                 "abcdefghijklmnopqrstuvwxyz"
+                 "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+
+        def decode(self, ids):
+            return "".join(
+                self.chars[i % len(self.chars)] for i in ids
+            )
+
+    out = generate_json(cfg, params, CharTok(), list(range(10, 26)),
+                        max_new_tokens=60)
+    assert out, "no output produced"
+    v = JsonValidator()
+    assert v.feed(out)
+    if v.done:
+        json.loads(out)  # fully-formed output must parse
