@@ -91,6 +91,23 @@ def sdpa(
     """Backend-dispatching SDPA; see ``sdpa_reference`` for semantics."""
     from ipex_llm_tpu.ops import dispatch
 
+    rm = dispatch.ring_mesh()
+    if (
+        rm is not None
+        and q.shape[1] == k.shape[1]                  # full self-attention
+        and q.shape[1] % rm.shape["cp"] == 0
+        and kwargs.get("kv_start") is None            # no left padding
+        and kwargs.get("window") is None
+        and kwargs.get("softcap") is None
+        and kwargs.get("bias") is None
+    ):
+        from ipex_llm_tpu.ops.ring_attention import ring_sdpa
+
+        return ring_sdpa(
+            q, k, v, rm, causal=kwargs.get("causal", True),
+            scale=kwargs.get("scale"),
+        )
+
     if dispatch.use_pallas() and q.shape[1] >= 128 and kwargs.get("bias") is None:
         try:
             from ipex_llm_tpu.ops.pallas import flash_attention

@@ -45,6 +45,28 @@ def spmd(active: bool):
         _spmd_active = prev
 
 
+# Context-parallel ring attention (ops/ring_attention.py): set by the
+# training/prefill caller that guarantees full-sequence causal semantics
+# (no left-pad, no sliding window).  None = dense attention.
+_ring_mesh = None
+
+
+def ring_mesh():
+    return _ring_mesh
+
+
+@contextmanager
+def ring(mesh):
+    """Scoped context-parallel mesh for sdpa dispatch."""
+    global _ring_mesh
+    prev = _ring_mesh
+    _ring_mesh = mesh
+    try:
+        yield
+    finally:
+        _ring_mesh = prev
+
+
 def use_pallas() -> bool:
     if _spmd_active:
         return False

@@ -1,0 +1,114 @@
+"""Ring attention over a ``cp`` mesh axis (context/sequence parallelism).
+
+The reference has NO sequence parallelism — its long-context levers are
+memory-side only (fp8 KV, SnapKV; SURVEY.md §5) — so this op is the
+"exceed the reference" capability: sequences shard over ``cp``, each device
+holds a [B, S/cp, H, D] chunk of Q/K/V, and K/V blocks rotate around the
+ring via ``ppermute`` while a streaming-softmax accumulator builds the
+exact attention output.  Communication rides ICI; peak memory per device is
+O(S/cp) instead of O(S).
+
+Math: classic online softmax (flash-attention accumulation) — per ring step
+``s = q·k_blk``, running max ``m``, normalizer ``l``, and rescaled value
+accumulator; causal masking uses global positions so the result is
+bit-for-bit the same attention as the dense computation (up to fp
+accumulation order).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_body(r, state, axis_name: str, n_dev: int, s_blk: int, scale,
+               causal: bool, n_rep: int):
+    m, l, acc, k_blk, v_blk, q, my_idx = state
+    # which global block the K/V chunk we currently hold came from
+    blk_idx = (my_idx - r) % n_dev
+    q_pos = my_idx * s_blk + jnp.arange(q.shape[1])          # [Sq]
+    kv_pos = blk_idx * s_blk + jnp.arange(k_blk.shape[1])    # [Sk]
+
+    kr = jnp.repeat(k_blk, n_rep, axis=2) if n_rep > 1 else k_blk
+    vr = jnp.repeat(v_blk, n_rep, axis=2) if n_rep > 1 else v_blk
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   kr.astype(jnp.float32)) * scale
+    if causal:
+        mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        s = jnp.where(mask, s, NEG_INF)
+
+    m_new = jnp.maximum(m, s.max(axis=-1))                   # [B,H,T]
+    # guard fully-masked rows (m_new == NEG_INF): exp(0)=1 but l stays 0
+    alpha = jnp.exp(jnp.where(m_new <= NEG_INF / 2, 0.0, m - m_new))
+    p = jnp.exp(jnp.where(m_new[..., None] <= NEG_INF / 2, NEG_INF,
+                          s - m_new[..., None]))
+    l = l * alpha + p.sum(axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhts,bshd->bhtd", p, vr.astype(jnp.float32)
+    )
+
+    # rotate K/V to the next device in the ring
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+    v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return (m_new, l, acc, k_blk, v_blk, q, my_idx)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, n_dev: int,
+                          scale: float, causal: bool):
+    """Runs inside shard_map: q/k/v are the per-device chunks."""
+    b, s_blk, hq, d = q.shape
+    hkv = k.shape[2]
+    n_rep = hq // hkv
+    my_idx = jax.lax.axis_index(axis_name)
+
+    m = jnp.full((b, hq, s_blk), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hq, s_blk), jnp.float32)
+    acc = jnp.zeros((b, hq, s_blk, d), jnp.float32)
+
+    body = partial(_ring_body, axis_name=axis_name, n_dev=n_dev,
+                   s_blk=s_blk, scale=scale, causal=causal, n_rep=n_rep)
+    state = (m, l, acc, k, v, q, my_idx)
+    for r in range(n_dev):  # unrolled: n_dev is small and static
+        state = body(r, state)
+    m, l, acc = state[0], state[1], state[2]
+    out = acc / jnp.maximum(l, 1e-20)[..., None]             # [B,H,T,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,T,H,D]
+
+
+def ring_sdpa(
+    q: jnp.ndarray,   # [B, S, Hq, D] (full logical sequence)
+    k: jnp.ndarray,   # [B, S, Hkv, D]
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis: str = "cp",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact attention with the sequence sharded over ``mesh[axis]``."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[axis]
+    if q.shape[1] % n_dev != 0:
+        raise ValueError(f"sequence {q.shape[1]} not divisible by {axis}={n_dev}")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=axis, n_dev=n_dev,
+                scale=scale, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -154,6 +154,11 @@ class OpenAIServer:
     async def chat(self, request):
         body = await request.json()
         ids = self._encode_chat(body.get("messages", []))
+        rf = body.get("response_format") or {}
+        if rf.get("type") in ("json_object", "json_schema"):
+            # constrained decoding runs the offline validator-filtered path
+            # (structured.py), bypassing the batch engine
+            return await self._chat_json(body, ids)
         req = self.engine.submit(self._mk_request(body, ids))
         rid = f"chatcmpl-{req.request_id[:12]}"
 
@@ -182,6 +187,31 @@ class OpenAIServer:
                 "completion_tokens": len(req.output_ids),
                 "total_tokens": len(req.prompt_ids) + len(req.output_ids),
             },
+        })
+
+    async def _chat_json(self, body: dict, ids: list[int]):
+        import asyncio as _asyncio
+
+        from ipex_llm_tpu.structured import generate_json
+
+        loop = _asyncio.get_running_loop()
+        text = await loop.run_in_executor(
+            None,
+            lambda: generate_json(
+                self.engine.cfg, self.engine.params, self.tok, ids,
+                max_new_tokens=int(body.get("max_tokens") or 256),
+            ),
+        )
+        return web.json_response({
+            "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+            "object": "chat.completion", "created": _now(),
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": "stop",
+            }],
+            "usage": {"prompt_tokens": len(ids)},
         })
 
     async def completions(self, request):

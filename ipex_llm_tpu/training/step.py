@@ -45,16 +45,32 @@ def make_train_step(
     cfg: ModelConfig,
     optimizer: Any,
     loss_fn: Callable | None = None,
+    ring_mesh=None,
 ) -> Callable:
     """Build a jitted ``step(params, opt_state, tokens) -> (params, opt_state,
-    loss)``.  ``optimizer`` is any optax GradientTransformation."""
+    loss)``.  ``optimizer`` is any optax GradientTransformation.
+
+    ``ring_mesh``: a mesh with a ``cp`` axis — attention runs as ring
+    attention with the sequence sharded over it (ops/ring_attention.py),
+    the long-context training mode the reference lacks entirely.
+    """
     import optax
 
-    loss_fn = loss_fn or causal_lm_loss
+    from ipex_llm_tpu.ops import dispatch
+
+    base_loss = loss_fn or causal_lm_loss
+
+    def loss_with_ring(cfg, params, tokens):
+        if ring_mesh is not None and ring_mesh.shape.get("cp", 1) > 1:
+            with dispatch.ring(ring_mesh):
+                return base_loss(cfg, params, tokens)
+        return base_loss(cfg, params, tokens)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn, argnums=1)(cfg, params, tokens)
+        loss, grads = jax.value_and_grad(loss_with_ring, argnums=1)(
+            cfg, params, tokens
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

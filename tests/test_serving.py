@@ -233,6 +233,22 @@ def test_http_stop_sequence(http_server):
     assert body["choices"][0]["finish_reason"] == "stop"
 
 
+def test_http_response_format_json(http_server):
+    """response_format json_object routes through constrained decoding."""
+    from ipex_llm_tpu.structured import JsonValidator
+
+    port = http_server
+    resp = _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "1 2 3"}],
+        "max_tokens": 24,
+        "response_format": {"type": "json_object"},
+    })
+    body = json.loads(resp.read())
+    text = body["choices"][0]["message"]["content"]
+    v = JsonValidator()
+    assert v.feed(text), text  # always a valid JSON prefix
+
+
 def test_http_models_and_health(http_server):
     port = http_server
     body = json.loads(urllib.request.urlopen(

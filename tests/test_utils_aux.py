@@ -1,0 +1,49 @@
+"""Aux subsystems: error helpers, lazy import, profiling, health probe."""
+
+import time
+
+import pytest
+
+from ipex_llm_tpu.parallel import bootstrap
+from ipex_llm_tpu.profiling import StepTimer, trace
+from ipex_llm_tpu.utils import LazyImport, invalidInputError
+
+
+def test_invalid_input_error():
+    invalidInputError(True, "fine")
+    with pytest.raises(RuntimeError, match="bad thing"):
+        invalidInputError(False, "bad thing", fixMsg="do the other thing")
+
+
+def test_lazy_import():
+    mod = LazyImport("json")
+    assert mod.dumps({"a": 1}) == '{"a": 1}'
+
+
+def test_health_probe():
+    h = bootstrap.health()
+    assert h["ok"] and h["n_devices"] >= 1
+    assert h["process_count"] == 1
+
+
+def test_step_timer():
+    t = StepTimer().start()
+    time.sleep(0.01)
+    t.tick()       # first token
+    time.sleep(0.005)
+    t.tick()
+    s = t.summary()
+    assert s["first_token_s"] >= 0.01
+    assert s["decode_tok_s"] > 0
+
+
+def test_trace_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("IPEX_LLM_TPU_PROFILE", raising=False)
+    with trace():   # must not start a profiler
+        pass
+
+
+def test_init_distributed_single_host(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_NUM_PROCESSES", raising=False)
+    assert bootstrap.init_distributed() is False
