@@ -260,6 +260,22 @@ def generate(
     b = tokens.shape[0]
 
     compress = kv_kind == "compress"
+    if compress:
+        from ipex_llm_tpu import compresskv
+
+        # Compression keeps capacity+window slots per row; a prompt that
+        # short would gather masked pad slots into the compressed cache and
+        # then attend garbage after renumbering.  Fall back to the normal
+        # cache for those rows' batch (mirrors the auto-path gate).
+        if int(lengths.min()) <= compresskv.capacity() + compresskv.window():
+            import warnings
+
+            warnings.warn(
+                "kv_kind='compress' needs every prompt longer than "
+                f"capacity+window ({compresskv.capacity()}+{compresskv.window()}); "
+                "falling back to the normal KV cache", stacklevel=2,
+            )
+            compress, kv_kind = False, "normal"
     if kv_kind == "auto":
         from ipex_llm_tpu import compresskv
 
@@ -285,7 +301,7 @@ def generate(
 
     from ipex_llm_tpu.ops import dispatch as _dispatch
 
-    with _dispatch.spmd(mesh is not None and mesh.size > 1):
+    with _dispatch.spmd(mesh if mesh is not None and mesh.size > 1 else None):
         return _generate_inner(
             cfg, params, gen, tokens, lengths, tpad, b, cache, mesh, streamer,
             compress,

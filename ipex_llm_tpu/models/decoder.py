@@ -33,6 +33,12 @@ from ipex_llm_tpu.ops.norms import layer_norm, rms_norm
 
 COMPUTE_DTYPE = jnp.bfloat16
 
+# Non-trainable buffer leaves of the param pytree (the reference registers
+# inv_freq as a torch buffer).  Single source of truth: decoder_forward
+# stop_gradients them (no grad flow) and training/step.py zeroes their
+# optimizer updates (no adamw weight-decay drift) from this same list.
+FROZEN_BUFFER_KEYS = ("inv_freq", "rope_mscale")
+
 
 def _norm(x, w, cfg: ModelConfig, bias=None):
     if cfg.norm_kind == "layer":
@@ -220,8 +226,16 @@ def decoder_forward(
 
     cos, sin = (None, None)
     if cfg.rope is not None:
+        # FROZEN_BUFFER_KEYS are non-trainable: without stop_gradient, full
+        # fine-tuning / LISA would drift the RoPE tables every step.
+        def frozen(key, default=None):
+            v = params.get(key, default)
+            return v if isinstance(v, (float, int, type(None))) else (
+                jax.lax.stop_gradient(v)
+            )
+
         cos, sin = rope_ops.cos_sin(
-            rope_positions, params["inv_freq"], params.get("rope_mscale", 1.0)
+            rope_positions, frozen("inv_freq"), frozen("rope_mscale", 1.0)
         )
 
     if slot_offsets is not None:

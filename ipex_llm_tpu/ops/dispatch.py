@@ -18,31 +18,49 @@ from functools import lru_cache
 import jax
 
 
-# When a >1-device mesh drives the model, the compute path must stay at the
-# XLA/GSPMD level: a bare ``pallas_call`` inside ``jit`` does not partition
-# under sharding propagation (it would need a shard_map wrapper).  The
-# generate/forward drivers flip this flag while tracing sharded programs.
+# When a >1-device mesh drives the model, a bare ``pallas_call`` inside
+# ``jit`` does not partition under GSPMD sharding propagation — kernels must
+# be wrapped in ``jax.shard_map`` with per-shard block specs.  The generate/
+# forward drivers record the active mesh here; the op dispatchers use it to
+# emit shard_map-wrapped kernel calls (ops/pallas/*::*_sharded), falling back
+# to the jnp/GSPMD path when no sharded wrapper applies.
+_spmd_mesh = None
 _spmd_active: bool = False
-
-
-def set_spmd(active: bool) -> None:
-    global _spmd_active
-    _spmd_active = bool(active)
 
 
 from contextlib import contextmanager
 
 
 @contextmanager
-def spmd(active: bool):
-    """Scoped SPMD flag that restores the previous value (nesting-safe)."""
-    global _spmd_active
-    prev = _spmd_active
-    _spmd_active = bool(active) or prev
+def spmd(mesh_or_active):
+    """Scoped SPMD context (nesting-safe).
+
+    Pass the active ``jax.sharding.Mesh`` so kernel dispatch can emit
+    shard_map-wrapped Pallas calls; a bare ``True`` marks SPMD tracing with
+    an unknown mesh (kernels then fall back to the jnp path, the pre-r3
+    behaviour).  Falsy values are a no-op passthrough.
+    """
+    global _spmd_mesh, _spmd_active
+    prev_mesh, prev_active = _spmd_mesh, _spmd_active
+    if mesh_or_active is None or mesh_or_active is False:
+        pass
+    elif mesh_or_active is True:
+        # unknown mesh: kernels must fall back to jnp, so the outer scope's
+        # recorded mesh must not leak into this scope
+        _spmd_mesh = None
+        _spmd_active = True
+    else:
+        _spmd_mesh = mesh_or_active
+        _spmd_active = True
     try:
         yield
     finally:
-        _spmd_active = prev
+        _spmd_mesh, _spmd_active = prev_mesh, prev_active
+
+
+def spmd_mesh():
+    """The mesh recorded by the innermost ``spmd(mesh)`` scope (or None)."""
+    return _spmd_mesh
 
 
 # Context-parallel ring attention (ops/ring_attention.py): set by the
@@ -68,6 +86,13 @@ def ring(mesh):
 
 
 def use_pallas() -> bool:
+    """Kernel eligibility for the *unsharded* (single-device) call form.
+
+    Under SPMD the per-op dispatchers instead consult :func:`spmd_mesh` and
+    route through the shard_map-wrapped kernel entry points; a bare kernel
+    would not partition, so this returns False while a mesh without a
+    sharded wrapper is active.
+    """
     if _spmd_active:
         return False
     return _use_pallas_env()
@@ -77,10 +102,17 @@ def use_pallas() -> bool:
 def _use_pallas_env() -> bool:
     if os.environ.get("IPEX_LLM_TPU_DISABLE_PALLAS", "0") == "1":
         return False
+    if os.environ.get("IPEX_LLM_TPU_FORCE_PALLAS", "0") == "1":
+        return True  # tests: interpret-mode kernels on CPU
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
+
+
+def use_pallas_sharded() -> bool:
+    """Kernel eligibility for shard_map-wrapped entry points."""
+    return _use_pallas_env()
 
 
 def clear_cache() -> None:

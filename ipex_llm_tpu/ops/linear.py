@@ -27,20 +27,36 @@ def qmatmul_reference(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -
     )
 
 
+_PALLAS_QTYPES = ("sym_int4", "asym_int4", "nf4", "fp4", "sym_int8")
+
+
 def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
     """Quantized matmul with backend dispatch.
 
     The Pallas path currently covers the 4-bit packed formats (sym_int4 /
     asym_int4 / nf4 / fp4) and sym_int8 — the formats the reference routes to
-    ``xe_linear``/``xe_batch`` — and is gated on TPU availability.
+    ``xe_linear``/``xe_batch`` — and is gated on TPU availability.  Under an
+    active SPMD mesh, TP-sharded weights (``qt.tp_mode`` stamped by
+    parallel/shard.py) run the shard_map-wrapped kernel; everything else
+    falls back to the XLA dequant path which GSPMD partitions itself.
     """
-    if dispatch.use_pallas() and qt.qtype in (
-        "sym_int4",
-        "asym_int4",
-        "nf4",
-        "fp4",
-        "sym_int8",
+    mesh = dispatch.spmd_mesh()
+    if (
+        mesh is not None
+        and qt.tp_mode in ("col", "row")
+        and mesh.shape.get("tp", 1) > 1
+        and dispatch.use_pallas_sharded()
+        and qt.qtype in _PALLAS_QTYPES
     ):
+        try:
+            from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
+
+            return pallas_qmatmul.qmatmul_pallas_sharded(
+                x, qt, mesh, compute_dtype
+            )
+        except (ImportError, NotImplementedError):
+            pass
+    if dispatch.use_pallas() and qt.qtype in _PALLAS_QTYPES:
         try:
             from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
 

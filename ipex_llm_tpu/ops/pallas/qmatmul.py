@@ -166,8 +166,13 @@ def _kernel_nozero(x_ref, d_ref, s_ref, o_ref, **kw):
     _kernel(x_ref, d_ref, s_ref, None, o_ref, **kw)
 
 
-def qmatmul_pallas(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16):
-    """x [..., in] @ dequant(qt) -> [..., out] via the fused Pallas kernel."""
+def qmatmul_pallas(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16,
+                   keep_f32: bool = False):
+    """x [..., in] @ dequant(qt) -> [..., out] via the fused Pallas kernel.
+
+    ``keep_f32`` returns the fp32 accumulator untouched (the row-parallel
+    shard_map wrapper psums partial products in fp32 before the final cast).
+    """
     if qt.qtype not in _SUPPORTED:
         raise NotImplementedError(qt.qtype)
     lead = x.shape[:-1]
@@ -182,4 +187,61 @@ def qmatmul_pallas(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16):
         qtype=qt.qtype, bs=qt.block_size, logical_out=qt.out_features,
         compute_dtype=compute_dtype,
     )
-    return out.reshape(*lead, qt.out_features).astype(x.dtype)
+    out = out.reshape(*lead, qt.out_features)
+    return out if keep_f32 else out.astype(x.dtype)
+
+
+def qmatmul_pallas_sharded(x: jnp.ndarray, qt: QTensor, mesh,
+                           compute_dtype=jnp.bfloat16):
+    """Tensor-parallel fused dequant-matmul: the kernel runs per-shard under
+    ``jax.shard_map`` with only the ``tp`` axis manual, so dp/pp/cp stay
+    under GSPMD management (partial-auto mode).
+
+    - ``tp_mode='col'`` (qkv/gate_up): weight planes sharded on the out
+      axis, x replicated over tp, output tp-sharded on its last axis — no
+      collective.
+    - ``tp_mode='row'`` (o/down): weight planes sharded on the in axis, x
+      tp-sharded on its last axis, fp32 partials combined with ``psum``
+      over ICI (the AutoTP ``inference_all_reduce`` equivalent, reference
+      low_bit_linear.py:715-722) — but here fused right after the kernel.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if qt.qtype not in _SUPPORTED:
+        raise NotImplementedError(qt.qtype)
+    tp = mesh.shape["tp"]
+    lead = (None,) * (x.ndim - 1)
+    has_zeros = qt.zeros is not None
+
+    if qt.tp_mode == "col":
+        if qt.out_features % tp:
+            raise NotImplementedError("out_features not divisible by tp")
+        local_shape = (qt.in_features, qt.out_features // tp)
+        w_spec = P(None, "tp")
+        x_spec = P(*lead, None)
+        out_spec = P(*lead, "tp")
+    elif qt.tp_mode == "row":
+        bs = qt.block_size or 1
+        if qt.in_features % (bs * tp):
+            raise NotImplementedError("in_features not divisible by bs*tp")
+        local_shape = (qt.in_features // tp, qt.out_features)
+        w_spec = P("tp", None)
+        x_spec = P(*lead, "tp")
+        out_spec = P(*lead, None)
+    else:
+        raise NotImplementedError(f"tp_mode={qt.tp_mode}")
+
+    def run(xl, data, scales, zeros=None):
+        lqt = QTensor(data, scales, zeros, qt.qtype, local_shape,
+                      qt.block_size)
+        if qt.tp_mode == "col":
+            return qmatmul_pallas(xl, lqt, compute_dtype)
+        part = qmatmul_pallas(xl, lqt, compute_dtype, keep_f32=True)
+        return jax.lax.psum(part, "tp").astype(xl.dtype)
+
+    in_specs = [x_spec, w_spec, w_spec] + ([w_spec] if has_zeros else [])
+    args = [x, qt.data, qt.scales] + ([qt.zeros] if has_zeros else [])
+    return jax.shard_map(
+        run, mesh=mesh, axis_names={"tp"},
+        in_specs=tuple(in_specs), out_specs=out_spec, check_vma=False,
+    )(*args)

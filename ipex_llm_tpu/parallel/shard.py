@@ -37,7 +37,7 @@ def _divisible(n: int, parts: int) -> bool:
 
 
 def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
-                  ep: int = 1, pp: int = 1) -> P:
+                  ep: int = 1, pp: int = 1) -> tuple[P, str | None]:
     """Pick the PartitionSpec for a QTensor's data/scales planes.
 
     All planes are laid out ``[(L,)? (E,)? in_like, out]``; col-parallel
@@ -46,6 +46,10 @@ def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
     per-rank layer slices, pipeline_parallel.py:166-234, without the
     process groups) and an expert axis (MoE stacks) over ``ep``.  Falls
     back to replication when an axis does not divide evenly.
+
+    Returns (spec, tp_mode): ``tp_mode`` is the mode stamped onto the
+    QTensor when the sharded Pallas kernel path can serve it ('col'/'row',
+    see ops/pallas/qmatmul.py::qmatmul_pallas_sharded), else None.
     """
     lead: tuple = ()
     if stacked:
@@ -57,10 +61,19 @@ def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
     data_in = qt.data.shape[-2]
     nb = qt.scales.shape[-2] if qt.scales is not None else data_in
     if kind == "col" and _divisible(qt.out_features, tp):
-        return P(*lead, None, "tp")
+        mode = "col" if tp > 1 else None
+        return P(*lead, None, "tp"), mode
     if kind == "row" and _divisible(data_in, tp) and _divisible(nb, tp):
-        return P(*lead, "tp", None)
-    return P(*lead, None, None)
+        # the kernel's x-shard/data-shard row alignment additionally needs
+        # whole quantization blocks per shard with no padded tail
+        bs = qt.block_size or 1
+        mode = (
+            "row"
+            if tp > 1 and bs and qt.in_features % (bs * tp) == 0
+            else None
+        )
+        return P(*lead, "tp", None), mode
+    return P(*lead, None, None), None
 
 
 def param_shardings(params: dict, mesh: Mesh) -> dict:
@@ -81,12 +94,13 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
         return "pp" if pp > 1 and _divisible(n_layers or 0, pp) else None
 
     def qt_sharding(qt: QTensor, kind: str, stacked: bool):
-        spec = _qtensor_spec(qt, kind, tp, stacked, ep=ep, pp=pp)
+        spec, mode = _qtensor_spec(qt, kind, tp, stacked, ep=ep, pp=pp)
         return QTensor(
             data=ns(spec),
             scales=None if qt.scales is None else ns(spec),
             zeros=None if qt.zeros is None else ns(spec),
             qtype=qt.qtype, shape=qt.shape, block_size=qt.block_size,
+            tp_mode=mode,
         )
 
     def layer_entry(key: str, v: Any):
@@ -127,12 +141,21 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
-    """Place the param pytree onto the mesh under the TP rules."""
+    """Place the param pytree onto the mesh under the TP rules.
+
+    QTensor leaves are stamped with their ``tp_mode`` so op dispatch can
+    route them through the shard_map-wrapped Pallas kernels.
+    """
+    from dataclasses import replace as _dc_replace
+
     sh = param_shardings(params, mesh)
 
     def place(p, s):
         if s is None or isinstance(p, (float, int)):
             return p
+        if isinstance(p, QTensor) and isinstance(s, QTensor):
+            if p.tp_mode != s.tp_mode:  # aux must match for device_put
+                p = _dc_replace(p, tp_mode=s.tp_mode)
         return jax.device_put(p, s)
 
     out = {}

@@ -44,6 +44,10 @@ class QTensor:
     qtype:  resolved qtype name (static)
     shape:  logical (in_features, out_features) (static)
     block_size: contraction-axis block size (static)
+    tp_mode: tensor-parallel style stamped by parallel/shard.py —
+        'col' (out axis sharded over tp), 'row' (in axis sharded, psum
+        combine) or None.  Static so the Pallas dispatch can pick the
+        matching shard_map wrapper at trace time.
     """
 
     data: jnp.ndarray
@@ -52,15 +56,19 @@ class QTensor:
     qtype: str
     shape: tuple[int, int]
     block_size: int
+    tp_mode: str | None = None
 
     def tree_flatten(self):
-        return (self.data, self.scales, self.zeros), (self.qtype, self.shape, self.block_size)
+        return (self.data, self.scales, self.zeros), (
+            self.qtype, self.shape, self.block_size, self.tp_mode,
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         data, scales, zeros = children
-        qtype, shape, block_size = aux
-        return cls(data, scales, zeros, qtype, shape, block_size)
+        qtype, shape, block_size = aux[:3]
+        tp_mode = aux[3] if len(aux) > 3 else None
+        return cls(data, scales, zeros, qtype, shape, block_size, tp_mode)
 
     @property
     def in_features(self) -> int:
@@ -162,6 +170,60 @@ def _dequant_int_sym(qt: QTensor, bits: int):
     return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
 
 
+def _quant_int_sym_opt(w, bs: int, bits: int, weights=None, n_cand: int = 21,
+                       span: float = 0.25):
+    """Scale-search symmetric quantization (llama.cpp ``make_qx_quants``
+    style): per block, try ``n_cand`` scale multipliers around the absmax
+    scale and keep the one minimizing (optionally importance-weighted)
+    squared reconstruction error.  This is the error-compensated requant
+    used by LoRA merging and the ``imatrix``-weighted path
+    (``ggml_quantize_tensor_with_weights``, SURVEY §2.3): ``weights`` is a
+    per-input-channel importance vector ``[in_features]``.
+    """
+    blocks = _to_blocks(w, bs)                       # [nb, bs, out]
+    qmax = 1 << (bits - 1)
+    amax_idx = jnp.argmax(jnp.abs(blocks), axis=1, keepdims=True)
+    signed_max = jnp.take_along_axis(blocks, amax_idx, axis=1)
+    d0 = signed_max / -qmax                          # [nb, 1, out]
+    if weights is None:
+        # x² importance (llama.cpp make_qx_quants rmse_type=1): penalizes
+        # clipping the block's outliers, which dominate model quality
+        wgt = blocks * blocks
+    else:
+        wv = jnp.asarray(weights, jnp.float32).reshape(-1)
+        pad = (-wv.shape[0]) % bs
+        if pad:
+            wv = jnp.concatenate([wv, jnp.zeros((pad,), jnp.float32)])
+        wgt = wv.reshape(-1, bs, 1)                  # [nb, bs, 1]
+
+    def err_for(d):
+        inv_d = jnp.where(d == 0, 0.0, 1.0 / d)
+        q = jnp.clip(jnp.round(blocks * inv_d) + qmax, 0, 2 * qmax - 1)
+        recon = (q - qmax) * d
+        return (((blocks - recon) ** 2) * wgt).sum(axis=1), q  # [nb, out]
+
+    def body(carry, mult):
+        best_err, best_d = carry
+        d = d0 * mult
+        err, _ = err_for(d)
+        better = err < best_err
+        return (
+            jnp.where(better, err, best_err),
+            jnp.where(better, d[:, 0, :], best_d),
+        ), None
+
+    mults = jnp.linspace(1.0 - span, 1.0 + span, n_cand)
+    err0, _ = err_for(d0)
+    (best_err, best_d), _ = jax.lax.scan(body, (err0, d0[:, 0, :]), mults)
+    d = best_d[:, None, :]
+    inv_d = jnp.where(d == 0, 0.0, 1.0 / d)
+    q = jnp.clip(jnp.round(blocks * inv_d) + qmax, 0, 2 * qmax - 1)
+    codes = _from_blocks(q.astype(jnp.uint8))
+    scales = best_d.astype(SCALE_DTYPE)
+    data = _pack_nibbles(codes, bs) if bits == 4 else codes
+    return data, scales, None
+
+
 def _quant_int_asym(w, bs: int, bits: int):
     """q4_1/q5_1 style: d = (max-min)/(2^b-1), m = min; x ≈ q*d + m."""
     blocks = _to_blocks(w, bs)
@@ -203,6 +265,53 @@ def _quant_codebook(w, bs: int, qtype: str, bits: int):
     codes = numerics.codebook_encode(normalized, _codebook_table(qtype))
     codes = _from_blocks(codes)
     scales = d[:, 0, :].astype(SCALE_DTYPE)
+    data = _pack_nibbles(codes, bs) if bits == 4 else codes
+    return data, scales, None
+
+
+def _quant_codebook_opt(w, bs: int, qtype: str, bits: int, weights=None,
+                        n_cand: int = 21, span: float = 0.25):
+    """Scale-search codebook quantization (the nf4/fp4 peer of
+    ``_quant_int_sym_opt``): per block, pick the scale minimizing
+    importance-weighted squared reconstruction error."""
+    table = jnp.asarray(_codebook_table(qtype), jnp.float32)
+    blocks = _to_blocks(w, bs)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    d0 = jnp.where(amax == 0, 1.0, amax)                 # [nb, 1, out]
+    if weights is None:
+        wgt = blocks * blocks
+    else:
+        wv = jnp.asarray(weights, jnp.float32).reshape(-1)
+        pad = (-wv.shape[0]) % bs
+        if pad:
+            wv = jnp.concatenate([wv, jnp.zeros((pad,), jnp.float32)])
+        wgt = wv.reshape(-1, bs, 1)
+
+    def recon_err(d):
+        codes = numerics.codebook_encode(
+            jnp.clip(blocks / d, -1.0, 1.0), table
+        )
+        recon = numerics.codebook_decode(codes, table) * d
+        return (((blocks - recon) ** 2) * wgt).sum(axis=1)
+
+    def body(carry, mult):
+        best_err, best_d = carry
+        d = d0 * mult
+        err = recon_err(d)
+        better = err < best_err
+        return (
+            jnp.where(better, err, best_err),
+            jnp.where(better, d[:, 0, :], best_d),
+        ), None
+
+    mults = jnp.linspace(1.0 - span, 1.0 + span, n_cand)
+    (best_err, best_d), _ = jax.lax.scan(
+        body, (recon_err(d0), d0[:, 0, :]), mults
+    )
+    d = best_d[:, None, :]
+    codes = numerics.codebook_encode(jnp.clip(blocks / d, -1.0, 1.0), table)
+    codes = _from_blocks(codes)
+    scales = best_d.astype(SCALE_DTYPE)
     data = _pack_nibbles(codes, bs) if bits == 4 else codes
     return data, scales, None
 
@@ -260,14 +369,21 @@ def _as_jnp_f32(w: Any) -> jnp.ndarray:
     return jnp.asarray(np.asarray(w), dtype=jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("qtype", "block_size"))
-def _quantize_jit(w: jnp.ndarray, qtype: str, block_size: int):
+@partial(jax.jit, static_argnames=("qtype", "block_size", "optimize"))
+def _quantize_jit(w: jnp.ndarray, qtype: str, block_size: int,
+                  optimize: bool = False, imatrix=None):
     info = qtypes.resolve(qtype)
     if info.kind == "int_sym":
+        if optimize or imatrix is not None:
+            return _quant_int_sym_opt(w, block_size, int(info.bits),
+                                      weights=imatrix)
         return _quant_int_sym(w, block_size, int(info.bits))
     if info.kind == "int_asym":
         return _quant_int_asym(w, block_size, int(info.bits))
     if info.kind == "codebook":
+        if optimize or imatrix is not None:
+            return _quant_codebook_opt(w, block_size, info.name,
+                                       int(info.bits), weights=imatrix)
         return _quant_codebook(w, block_size, info.name, int(info.bits))
     if info.kind == "minifloat":
         if info.name == "fp6":
@@ -276,11 +392,18 @@ def _quantize_jit(w: jnp.ndarray, qtype: str, block_size: int):
     raise ValueError(f"cannot block-quantize kind={info.kind} ({qtype})")
 
 
-def quantize(w: Any, qtype: str, block_size: int | None = None) -> QTensor:
+def quantize(w: Any, qtype: str, block_size: int | None = None, *,
+             optimize: bool = False, imatrix: Any = None) -> QTensor:
     """Quantize a 2-D ``[in_features, out_features]`` weight.
 
     Reference counterpart: ``FP4Params.quantize`` → ``ggml_convert_qtype``
     (low_bit_linear.py:370,106); here a pure-jnp jitted codec.
+
+    ``optimize=True`` runs the per-block scale search (more faithful, ~20×
+    the codec cost — used for LoRA merges).  ``imatrix`` is a per-input-
+    channel importance vector enabling weighted quantization (the
+    reference's ``ggml_quantize_tensor_with_weights``); it implies the
+    scale-search path.
     """
     import numpy as _np
 
@@ -289,6 +412,8 @@ def quantize(w: Any, qtype: str, block_size: int | None = None) -> QTensor:
         isinstance(w, _np.ndarray)
         and info.kind == "int_sym"
         and int(info.bits) in (4, 8)
+        and not optimize
+        and imatrix is None
     ):
         # C++ quantizer (the ggml CPU quantizer equivalent, native/): same
         # math, fraction of the load-time cost; falls through when the
@@ -309,11 +434,33 @@ def quantize(w: Any, qtype: str, block_size: int | None = None) -> QTensor:
     w = _as_jnp_f32(w)
     if w.ndim != 2:
         raise ValueError(f"expected 2-D weight, got shape {w.shape}")
+    if imatrix is not None:
+        im_np = np.asarray(imatrix, np.float32).reshape(-1)
+        if im_np.shape[0] != w.shape[0]:
+            raise ValueError(
+                f"imatrix length {im_np.shape[0]} != in_features {w.shape[0]}"
+                " (importance is per input channel, reference"
+                " ggml_quantize_tensor_with_weights)"
+            )
+        imatrix = im_np
+    if (optimize or imatrix is not None) and info.kind not in (
+        "int_sym", "codebook"
+    ):
+        import warnings
+
+        warnings.warn(
+            f"optimize/imatrix quantization is not implemented for "
+            f"kind={info.kind!r} ({qtype}); using the standard codec",
+            stacklevel=2,
+        )
+        optimize, imatrix = False, None
     if info.kind == "native":
         dt = jnp.float16 if info.name == "fp16" else jnp.bfloat16
         return QTensor(w.astype(dt), None, None, info.name, tuple(w.shape), 0)
     bs = block_size or info.block_size
-    data, scales, zeros = _quantize_jit(w, info.name, bs)
+    im = None if imatrix is None else jnp.asarray(imatrix, jnp.float32)
+    data, scales, zeros = _quantize_jit(w, info.name, bs, optimize=optimize,
+                                        imatrix=im)
     return QTensor(data, scales, zeros, info.name, tuple(w.shape), bs)
 
 

@@ -68,7 +68,9 @@ class OpenAIServer:
         req = Request(
             prompt_ids=prompt_ids,
             max_new_tokens=num("max_tokens", 128, int),
-            temperature=num("temperature", 0.0, float),
+            # OpenAI API defaults: temperature=1.0, top_p=1.0 (clients
+            # relying on the documented default expect sampled output)
+            temperature=num("temperature", 1.0, float),
             top_p=num("top_p", 1.0, float),
             eos_token_id=eos,
             request_id=str(uuid.uuid4()),

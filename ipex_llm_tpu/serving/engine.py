@@ -244,7 +244,11 @@ class ServingEngine:
 
     def _finish(self, row: int, reason: str):
         req = self.rows[row]
-        req.finish_reason = reason
+        # first writer wins: the HTTP handler may have already recorded
+        # 'stop' (stop-string truncation) before asking for the abort —
+        # overwriting it here would misreport the finish reason
+        if req.finish_reason is None:
+            req.finish_reason = reason
         req.stream_queue.put(None)
         self.rows[row] = None
         self.row_lens[row] = 0

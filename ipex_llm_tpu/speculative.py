@@ -219,7 +219,7 @@ def speculative_generate(
         raise NotImplementedError("speculative decoding is greedy-only")
     from ipex_llm_tpu.ops import dispatch as _dispatch
 
-    with _dispatch.spmd(mesh is not None and mesh.size > 1):
+    with _dispatch.spmd(mesh if mesh is not None and mesh.size > 1 else None):
         return _speculative_inner(
             cfg, params, input_ids, gen, draft_params, draft_cfg,
             max_step_draft, lookup, ngram_size, mesh,

@@ -94,6 +94,8 @@ class JsonValidator:
         if top == "DEAD":
             return False
         if top in ("vstr", "kstr"):
+            if ord(c) < 0x20:          # raw control chars are invalid in JSON
+                return False
             if c == "\\":
                 self.stack.append("esc")
             elif c == '"':
@@ -105,6 +107,18 @@ class JsonValidator:
             return True
         if top == "esc":
             self.stack.pop()
+            if c == "u":               # \uXXXX: exactly 4 hex digits
+                self.stack.append("hex:0")
+                return True
+            return c in '"\\/bfnrt'
+        if top.startswith("hex:"):
+            if c not in "0123456789abcdefABCDEF":
+                return False
+            n = int(top[4:]) + 1
+            if n == 4:
+                self.stack.pop()
+            else:
+                self.stack[-1] = f"hex:{n}"
             return True
         if top == "num":
             if c in _DIGITS + ".eE+-":

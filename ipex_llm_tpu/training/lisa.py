@@ -52,11 +52,13 @@ def make_lisa_train_step(cfg: ModelConfig, optimizer, loss_fn=None):
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, layer_mask):
+        from ipex_llm_tpu.training.step import freeze_buffer_updates
+
         loss, grads = jax.value_and_grad(loss_fn, argnums=1)(cfg, params,
                                                              tokens)
         grads = mask_layer_grads(grads, layer_mask)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params = optax.apply_updates(params, freeze_buffer_updates(updates))
         return params, opt_state, loss
 
     return step

@@ -129,8 +129,11 @@ def merge_lora(params: dict, adapters: dict, lora_cfg: LoraConfig) -> dict:
             for i in range(n_l):
                 qt_i = jax.tree_util.tree_map(lambda x: x[i], base)
                 w = qcore.dequantize(qt_i) + delta[i]
+                # error-compensated requant: per-block scale search keeps
+                # the merged model close to the attached-adapter model
                 merged.append(qcore.quantize(np.asarray(w), base.qtype,
-                                             base.block_size or None))
+                                             base.block_size or None,
+                                             optimize=True))
             layers[slot] = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *merged
             )

@@ -41,6 +41,20 @@ def causal_lm_loss(
     return nll.mean()
 
 
+# stop_gradient in decoder_forward zeroes the frozen buffers' grads, but
+# adamw's decoupled weight decay would still step them — so their optimizer
+# updates are zeroed too, from the same key list the decoder owns.
+from ipex_llm_tpu.models.decoder import FROZEN_BUFFER_KEYS
+
+
+def freeze_buffer_updates(updates: dict) -> dict:
+    out = dict(updates)
+    for k in FROZEN_BUFFER_KEYS:
+        if k in out and not isinstance(out[k], (float, int)):
+            out[k] = jax.tree_util.tree_map(jnp.zeros_like, out[k])
+    return out
+
+
 def make_train_step(
     cfg: ModelConfig,
     optimizer: Any,
@@ -72,7 +86,7 @@ def make_train_step(
             cfg, params, tokens
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params = optax.apply_updates(params, freeze_buffer_updates(updates))
         return params, opt_state, loss
 
     return step

@@ -215,7 +215,9 @@ class TPUModelForCausalLM:
         tokens_j = jnp.asarray(tokens)
         from ipex_llm_tpu.ops import dispatch as _dispatch
 
-        with _dispatch.spmd(self.mesh is not None and self.mesh.size > 1):
+        with _dispatch.spmd(
+            self.mesh if self.mesh is not None and self.mesh.size > 1 else None
+        ):
             if self.mesh is not None:
                 from ipex_llm_tpu.parallel.shard import shard_batch, shard_cache
 

@@ -13,6 +13,10 @@ same config API.  The benchmark (bench.py) is what exercises the real chip.
 
 import os
 
+# no persistent XLA cache in tests: CPU AOT cache entries are machine-feature
+# sensitive (loader warns / may SIGILL across heterogeneous CI hosts)
+os.environ["IPEX_LLM_TPU_COMPILE_CACHE"] = ""
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()

@@ -102,6 +102,45 @@ def test_jit_dequantize_traces_once():
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
 
 
+@pytest.mark.parametrize("qtype", ["sym_int4", "nf4"])
+def test_optimize_scale_search_not_worse(qtype):
+    """Scale-search quantization must not increase x²-weighted block error
+    (it includes the RTN scale among its candidates)."""
+    w = _w(256, 48)
+    rtn = np.asarray(dequantize(quantize(w, qtype)))
+    opt = np.asarray(dequantize(quantize(w, qtype, optimize=True)))
+    wgt = w.astype(np.float64) ** 2
+    err_rtn = float((wgt * (rtn - w) ** 2).sum())
+    err_opt = float((wgt * (opt - w) ** 2).sum())
+    assert err_opt <= err_rtn * (1 + 1e-6), (err_opt, err_rtn)
+
+
+def test_imatrix_weighting_prioritizes_important_channels():
+    """Reference ggml_quantize_tensor_with_weights equivalent: importance
+    weights must reduce reconstruction error on the weighted channels."""
+    w = _w(128, 32)
+    im = np.ones((128,), np.float32)
+    im[:16] = 100.0  # first 16 input channels matter much more
+    plain = np.asarray(dequantize(quantize(w, "sym_int4", optimize=True)))
+    weighted = np.asarray(dequantize(quantize(w, "sym_int4", imatrix=im)))
+    err_plain = float(((plain - w)[:16] ** 2).sum())
+    err_weighted = float(((weighted - w)[:16] ** 2).sum())
+    assert err_weighted <= err_plain * (1 + 1e-6)
+
+
+def test_imatrix_length_validated():
+    w = _w(128, 32)
+    with pytest.raises(ValueError, match="imatrix length"):
+        quantize(w, "sym_int4", imatrix=np.ones((32,), np.float32))
+
+
+def test_optimize_unsupported_kind_warns():
+    w = _w(128, 32)
+    with pytest.warns(UserWarning, match="not implemented"):
+        qt = quantize(w, "fp8_e4m3", optimize=True)
+    assert qt.qtype == "fp8_e4m3"  # standard codec still ran
+
+
 def test_zero_block_stability():
     w = np.zeros((64, 32), dtype=np.float32)
     for qtype in ["sym_int4", "asym_int4", "nf4", "fp8_e4m3", "fp6"]:

@@ -219,14 +219,16 @@ def test_abort_frees_row(cfg_params, engine):
 def test_http_stop_sequence(http_server):
     """A stop string truncates output and finishes with reason 'stop'."""
     port = http_server
-    # discover the greedy continuation first
+    # discover the greedy continuation first (temperature pinned: the
+    # server's OpenAI-compatible default is now 1.0 = sampled)
     resp = _post(port, "/v1/completions",
-                 {"prompt": "20 21 22 23 24", "max_tokens": 6})
+                 {"prompt": "20 21 22 23 24", "max_tokens": 6,
+                  "temperature": 0.0})
     full = json.loads(resp.read())["choices"][0]["text"].split()
     stop_word = full[2]
     resp = _post(port, "/v1/completions",
                  {"prompt": "20 21 22 23 24", "max_tokens": 6,
-                  "stop": stop_word})
+                  "temperature": 0.0, "stop": stop_word})
     body = json.loads(resp.read())
     text = body["choices"][0]["text"]
     assert stop_word not in text.split()

@@ -31,11 +31,25 @@ def test_validator_accepts_valid(text):
     "[1, ]",        # trailing comma then close
     '{"a": tru0}',
     "}",
+    '"bad \\q escape"',          # invalid escape char
+    '"trunc \\u12Z"',            # \u needs exactly 4 hex digits
+    '"ctrl \x01 char"',          # raw control char inside string
 ])
 def test_validator_rejects_invalid(text):
     v = JsonValidator()
     ok = v.feed(text)
     assert not (ok and v.done), text
+
+
+@pytest.mark.parametrize("text", [
+    '"esc \\n \\t \\\\ \\" \\/ ok"',
+    '"uni \\u0041\\u00e9"',
+])
+def test_validator_accepts_escapes(text):
+    v = JsonValidator()
+    assert v.feed(text), text
+    json.loads(text)
+    assert v.done
 
 
 def test_validator_prefixes_stay_valid():

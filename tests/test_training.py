@@ -70,6 +70,15 @@ def test_qlora_overfits_frozen_base(cfg_params_int4):
     assert q0.data.dtype == jnp.uint8
 
 
+def _dequant_stacked(qt):
+    from ipex_llm_tpu.quantize import core as qcore
+
+    return jnp.stack([
+        qcore.dequantize(jax.tree_util.tree_map(lambda x: x[i], qt))
+        for i in range(qt.data.shape[0])
+    ])
+
+
 def test_merge_lora_matches_attached(cfg_params_int4):
     cfg, params = cfg_params_int4
     lc = LoraConfig(r=4)
@@ -80,9 +89,44 @@ def test_merge_lora_matches_attached(cfg_params_int4):
     )
     tokens = _batch(cfg, seed=7)
     attached = causal_lm_loss(cfg, attach_lora(params, adapters, lc), tokens)
-    merged = causal_lm_loss(cfg, merge_lora(params, adapters, lc), tokens)
-    # merge requantizes INT4, so allow quantization-level tolerance
-    assert abs(float(attached) - float(merged)) < 0.08
+    merged_params = merge_lora(params, adapters, lc)
+    merged = causal_lm_loss(cfg, merged_params, tokens)
+
+    # Derived tolerance, not a magic number: merged = W_eff + eps where eps is
+    # block-rounding noise (zero-mean, Var <= d^2/12 per weight, d the block
+    # scale).  First order, loss drift = grad(L) . eps, whose std is
+    # sqrt(sum_i g_i^2 d_i^2 / 12); assert within 3 sigma.  On this tiny
+    # model the int4 noise floor is large relative to the loss, which is why
+    # a fixed small tolerance was flaky across weight instances.
+    slots = list(adapters.keys())
+    dense = dict(params)
+    dense["layers"] = dict(params["layers"])
+    for s in slots:
+        delta = jnp.einsum("lir,lro->lio", adapters[s]["a"],
+                           adapters[s]["b"]) * lc.scale
+        dense["layers"][s] = _dequant_stacked(params["layers"][s]) + delta
+
+    def loss_of(ws):
+        d2 = dict(dense)
+        d2["layers"] = dict(dense["layers"])
+        for s in slots:
+            d2["layers"][s] = ws[s]
+        return causal_lm_loss(cfg, d2, tokens)
+
+    grads = jax.grad(loss_of)({s: dense["layers"][s] for s in slots})
+    var = 0.0
+    for s in slots:
+        mq = merged_params["layers"][s]
+        d = mq.scales.astype(jnp.float32)
+        g = grads[s]
+        n_l, n_in, n_out = g.shape
+        pad = (-n_in) % mq.block_size
+        if pad:
+            g = jnp.pad(g, ((0, 0), (0, pad), (0, 0)))
+        g2 = (g.reshape(n_l, -1, mq.block_size, n_out) ** 2).sum(axis=2)
+        var += float((g2 * d ** 2 / 12.0).sum())
+    bound = 3.0 * np.sqrt(var)
+    assert abs(float(attached) - float(merged)) < bound
 
 
 def test_relora_merge_reset(cfg_params_int4):
