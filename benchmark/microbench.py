@@ -1,0 +1,98 @@
+"""Kernel-level microbenchmarks on the real chip.
+
+Times the decode-path hot ops in isolation (fused dequant-matmul at M=1,
+decode attention) against their XLA fallbacks, reporting effective HBM
+bandwidth — the decode roofline currency.  Run: python benchmark/microbench.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops.linear import qmatmul_reference
+from ipex_llm_tpu.ops.pallas.qmatmul import qmatmul_pallas
+from ipex_llm_tpu.ops.pallas.decode_attention import decode_sdpa
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.quantize import quantize
+
+
+def timeit(fn, *args, iters=50):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_qmatmul(m, k, n, qtype="sym_int4"):
+    rng = np.random.default_rng(0)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        qt = quantize((rng.standard_normal((k, n)) * 0.02).astype(np.float32),
+                      qtype)
+    dev = [d for d in jax.devices() if d.platform != "cpu"]
+    if dev:
+        qt = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, dev[0]) if hasattr(x, "shape") else x,
+            qt)
+    x = jnp.ones((m, k), jnp.bfloat16)
+    if dev:
+        x = jax.device_put(x, dev[0])
+
+    bytes_w = qt.nbytes + m * k * 2 + m * n * 4
+    f_pallas = jax.jit(lambda x: qmatmul_pallas(x, qt))
+    f_ref = jax.jit(lambda x: qmatmul_reference(x, qt))
+    tp = timeit(f_pallas, x)
+    tr = timeit(f_ref, x)
+    print(f"qmatmul {qtype} M={m} [{k}x{n}]: pallas {tp*1e6:8.1f}us "
+          f"({bytes_w/tp/1e9:6.1f} GB/s) | xla {tr*1e6:8.1f}us "
+          f"({bytes_w/tr/1e9:6.1f} GB/s)")
+
+
+def bench_decode_attn(b, hq, hkv, s, d, dtype=jnp.bfloat16):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, 1, hq, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32).astype(dtype)
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32).astype(dtype)
+    kv_len = jnp.full((b,), s, jnp.int32)
+    kv_start = jnp.zeros((b,), jnp.int32)
+    nbytes = 2 * b * hkv * s * d * k.dtype.itemsize
+
+    f_kern = jax.jit(lambda q, k, v: decode_sdpa(q, k, v, kv_len=kv_len,
+                                                 kv_start=kv_start))
+    def ref(q, k, v):
+        kd = k.astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        vd = v.astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        qpos = (kv_len - 1)[:, None]
+        return sdpa_reference(q, kd, vd, causal=True, q_positions=qpos,
+                              kv_len=kv_len, kv_start=kv_start)
+    f_ref = jax.jit(ref)
+    tk = timeit(f_kern, q, k, v)
+    tr = timeit(f_ref, q, k, v)
+    print(f"decode_attn B={b} Hq={hq} Hkv={hkv} S={s} D={d} {k.dtype}: "
+          f"kernel {tk*1e6:8.1f}us ({nbytes/tk/1e9:6.1f} GB/s) | "
+          f"xla {tr*1e6:8.1f}us ({nbytes/tr/1e9:6.1f} GB/s)")
+
+
+if __name__ == "__main__":
+    print("backend:", jax.default_backend())
+    # llama-7B decode shapes
+    bench_qmatmul(1, 4096, 12288)   # merged qkv
+    bench_qmatmul(1, 4096, 4096)    # o
+    bench_qmatmul(1, 4096, 22016)   # merged gate_up
+    bench_qmatmul(1, 11008, 4096)   # down
+    bench_qmatmul(1, 4096, 32000)   # lm head
+    bench_qmatmul(16, 4096, 22016)  # small-batch serving shape
+    bench_decode_attn(1, 32, 32, 1280, 128)
+    bench_decode_attn(1, 32, 8, 4096, 128)                 # GQA long
+    bench_decode_attn(1, 32, 8, 4096, 128, jnp.float8_e5m2)  # fp8 KV

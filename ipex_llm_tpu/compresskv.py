@@ -63,18 +63,18 @@ def compress(
     new_total: int,                # static: capacity + window + decode slack
 ) -> KVCache:
     """Shrink a prefilled cache to ``capacity`` ranked slots + the window."""
-    l, b, s, hkv, d = cache.k.shape
+    l, b, hkv, s, d = cache.k.shape
     w = window
     hq = obs_q.shape[3]
     n_rep = hq // hkv
     length = cache.length                      # prompt end slot (scalar)
 
-    k = cache.decode_layer(cache.k)            # [L,B,S,Hkv,D]
+    k = cache.decode_layer(cache.k)            # [L,B,Hkv,S,D] head-major
     # scores: window queries vs all keys, grouped to kv heads
     qf = obs_q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
-    scores = jnp.einsum("lbwhd,lbshd->lbhws", qf,
-                        jnp.repeat(kf, n_rep, axis=3) if n_rep > 1 else kf)
+    scores = jnp.einsum("lbwhd,lbhsd->lbhws", qf,
+                        jnp.repeat(kf, n_rep, axis=2) if n_rep > 1 else kf)
     scores = scores * (d ** -0.5)
     # mask invalid slots: before kv_start (left pad) and at/after length-w
     slot = jnp.arange(s)
@@ -96,19 +96,18 @@ def compress(
     _, keep = jax.lax.top_k(pooled, capacity)            # [L,B,Hkv,C]
     keep = jnp.sort(keep, axis=-1)                       # preserve slot order
 
-    def gather_layerwise(buf):                           # [L,B,S,Hkv,Dx]
-        moved = jnp.moveaxis(buf, 3, 2)                  # [L,B,Hkv,S,Dx]
+    def gather_layerwise(buf):                           # [L,B,Hkv,S,Dx]
         picked = jnp.take_along_axis(
-            moved, keep[..., None], axis=3
+            buf, keep[..., None], axis=3
         )                                                # [L,B,Hkv,C,Dx]
         win = jax.lax.dynamic_slice_in_dim(
-            moved, length - w, w, axis=3
+            buf, length - w, w, axis=3
         )                                                # [L,B,Hkv,W,Dx]
         newbuf = jnp.concatenate([picked, win], axis=3)  # [L,B,Hkv,C+W,Dx]
         pad = new_total - (capacity + w)
         if pad:
             newbuf = jnp.pad(newbuf, ((0, 0),) * 3 + ((0, pad), (0, 0)))
-        return jnp.moveaxis(newbuf, 2, 3)                # [L,B,new,Hkv,Dx]
+        return newbuf                                    # head-major already
 
     new_k = gather_layerwise(cache.k.astype(cache.k.dtype))
     new_v = gather_layerwise(cache.v)

@@ -6,7 +6,11 @@ helpers of models/utils.py:39-75.  The reference grows torch buffers in
 KV_ALLOC_BLOCK_LENGTH=256 chunks because eager PyTorch allows dynamic shapes;
 under XLA every shape must be static, so the TPU-native design is:
 
-- one pre-allocated ring of shape ``[L, B, S_max, Hkv, D]`` per k/v,
+- one pre-allocated ring of shape ``[L, B, Hkv, S_max, D]`` per k/v —
+  head-major so each head's ``[S, D]`` plane is contiguous, which is both
+  the DMA-friendly stream for the decode attention kernel (Mosaic requires
+  the last two block dims be the tile) and a free reshape for the flash
+  prefill kernel's ``[B·H, S, D]`` view,
 - an integer ``length`` scalar tracking the filled prefix,
 - updates via ``lax.dynamic_update_slice`` inside the jitted step,
 - capacity chosen by the generate loop from bucketed prompt+max_new lengths
@@ -31,7 +35,7 @@ import jax.numpy as jnp
 class KVCache:
     """Static-shape stacked-layer KV cache (the DynamicNormalCache peer)."""
 
-    k: jnp.ndarray  # [L, B, S_max, Hkv, D] storage dtype (bf16)
+    k: jnp.ndarray  # [L, B, Hkv, S_max, D] storage dtype (bf16)
     v: jnp.ndarray
     length: jnp.ndarray  # scalar int32: filled prefix length
 
@@ -52,15 +56,15 @@ class KVCache:
              head_dim: int, dtype=jnp.bfloat16, v_head_dim: int | None = None):
         vd = v_head_dim if v_head_dim is not None else head_dim
         return cls(
-            k=jnp.zeros((n_layers, batch, max_len, n_kv_heads, head_dim), dtype),
-            v=jnp.zeros((n_layers, batch, max_len, n_kv_heads, vd), dtype),
+            k=jnp.zeros((n_layers, batch, n_kv_heads, max_len, head_dim), dtype),
+            v=jnp.zeros((n_layers, batch, n_kv_heads, max_len, vd), dtype),
             length=jnp.zeros((), jnp.int32),
             storage="bf16",
         )
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     # -- per-layer access (used inside the layer scan) ----------------------
 
@@ -72,22 +76,24 @@ class KVCache:
 
     def update_layer(self, kl: jnp.ndarray, vl: jnp.ndarray,
                      new_k: jnp.ndarray, new_v: jnp.ndarray, pos: jnp.ndarray):
-        """Write new_k/new_v [B, T, H, D] into layer slices at offset pos.
+        """Write new_k/new_v [B, T, H, D] into layer slices [B, H, S, D] at
+        slot offset pos.
 
         ``pos`` scalar: one uniform slot offset for the whole batch (the
         generate loop's invariant).  ``pos`` [B]: per-row offsets (the
         continuous-batching engine, where rows decode at different lengths).
         """
+        new_k = self.encode(new_k).transpose(0, 2, 1, 3)   # [B, H, T, D]
+        new_v = self.encode(new_v).transpose(0, 2, 1, 3)
         if getattr(pos, "ndim", 0) == 1:
             write = jax.vmap(
                 lambda buf, new, p: jax.lax.dynamic_update_slice(
-                    buf, new, (p, 0, 0)
+                    buf, new, (0, p, 0)
                 )
             )
-            return (write(kl, self.encode(new_k), pos),
-                    write(vl, self.encode(new_v), pos))
-        kl = jax.lax.dynamic_update_slice(kl, self.encode(new_k), (0, pos, 0, 0))
-        vl = jax.lax.dynamic_update_slice(vl, self.encode(new_v), (0, pos, 0, 0))
+            return write(kl, new_k, pos), write(vl, new_v, pos)
+        kl = jax.lax.dynamic_update_slice(kl, new_k, (0, 0, pos, 0))
+        vl = jax.lax.dynamic_update_slice(vl, new_v, (0, 0, pos, 0))
         return kl, vl
 
     def advanced(self, n: int | jnp.ndarray) -> "KVCache":
@@ -104,9 +110,10 @@ class Fp8KVCache(KVCache):
              head_dim: int, dtype=jnp.bfloat16, v_head_dim: int | None = None):
         vd = v_head_dim if v_head_dim is not None else head_dim
         return cls(
-            k=jnp.zeros((n_layers, batch, max_len, n_kv_heads, head_dim),
+            k=jnp.zeros((n_layers, batch, n_kv_heads, max_len, head_dim),
                         jnp.float8_e5m2),
-            v=jnp.zeros((n_layers, batch, max_len, n_kv_heads, vd), jnp.float8_e5m2),
+            v=jnp.zeros((n_layers, batch, n_kv_heads, max_len, vd),
+                        jnp.float8_e5m2),
             length=jnp.zeros((), jnp.int32),
             storage="fp8",
         )

@@ -7,7 +7,7 @@ done once at weight-load time, and the per-layer loop is a ``lax.scan`` over
 stacked layer params so XLA compiles ONE layer body regardless of depth.
 
 Static-shape discipline (SURVEY.md §7 hard part (b)):
-- the KV cache is a fixed ``[L, B, S_max, H, D]`` ring (see kv.py),
+- the KV cache is a fixed head-major ``[L, B, H, S_max, D]`` ring (kv.py),
 - prompts are left-padded into buckets; RoPE uses logical positions while
   cache slots use physical indices, so decode writes are a single
   ``dynamic_update_slice`` at a uniform offset for the whole batch,
@@ -28,7 +28,7 @@ from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.ops import linear as linear_ops
 from ipex_llm_tpu.ops import mlp as mlp_ops
 from ipex_llm_tpu.ops import rope as rope_ops
-from ipex_llm_tpu.ops.attention import sdpa
+from ipex_llm_tpu.ops.attention import cached_sdpa
 from ipex_llm_tpu.ops.norms import layer_norm, rms_norm
 
 COMPUTE_DTYPE = jnp.bfloat16
@@ -93,13 +93,16 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
     obs_q = q[:, -collect_obs:] if collect_obs else jnp.zeros((0,), x.dtype)
 
     kl, vl = cache.update_layer(kl, vl, k, v, slot0)
-    kd = cache.decode_layer(kl, COMPUTE_DTYPE)
-    vd = cache.decode_layer(vl, COMPUTE_DTYPE)
 
-    attn = sdpa(
+    # the cache layer stays in storage dtype: decode steps read it directly
+    # through the specialized kernel (fp8 dequant in-kernel); other shapes
+    # cast once inside cached_sdpa
+    attn = cached_sdpa(
         q,
-        kd,
-        vd,
+        kl,
+        vl,
+        cache,
+        compute_dtype=COMPUTE_DTYPE,
         causal=True,
         q_positions=q_slots,
         kv_len=kv_len,
@@ -120,11 +123,11 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
     """Sparse-MoE FFN (mixtral/qwen-moe), reference deepseek.py:274-343 +
     common.py:342-375 ``moe_group_topk``/``moe_forward_vec``.
 
-    TPU-native: router in fp32, then ONE ``lax.scan`` over the stacked
-    expert QTensors computing every expert on every token and accumulating
-    ``gate[e] * expert_e(h)`` — mask-based dispatch keeps shapes static (no
-    ragged gather); with an ``ep`` mesh axis the scan body's expert slice is
-    resident per-device and XLA psums the combine.
+    Router in fp32, then sparse dispatch (ops/moe.py): decode-shaped
+    batches gather only the top-k experts' packed weights from HBM; larger
+    batches run capacity-bucketed dispatch with one vmapped expert matmul
+    (ep-shardable).  IPEX_LLM_TPU_DENSE_MOE=1 selects the dense
+    all-experts scan (the oracle used by the sparse-vs-dense tests).
     """
     h = _norm(x, lp["mlp_norm"], cfg)
     router_logits = jnp.matmul(
@@ -142,20 +145,30 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
         w = jax.nn.softmax(lg, axis=-1)
     if cfg.moe_router_scale != 1.0:
         w = w * cfg.moe_router_scale
-    # dense gate map [B,T,E]: zeros except the top-k columns
-    gates = (w[..., None] * jax.nn.one_hot(idx, n_e, dtype=w.dtype)).sum(-2)
 
-    def expert_step(acc, xs):
-        e_i, egu, edown = xs
-        gate, up = mlp_ops.split_gate_up(linear_ops.linear(h, egu))
-        y = linear_ops.linear(mlp_ops.gated_act_mul(gate, up, cfg.act), edown)
-        return acc + y * gates[..., e_i, None].astype(y.dtype), None
+    from ipex_llm_tpu.ops import moe as moe_ops
 
-    out, _ = jax.lax.scan(
-        expert_step,
-        jnp.zeros_like(x),
-        (jnp.arange(n_e), lp["moe_gate_up"], lp["moe_down"]),
-    )
+    if moe_ops.use_sparse():
+        out = moe_ops.moe_ffn(
+            h, w, idx, lp["moe_gate_up"], lp["moe_down"], cfg.act, n_e
+        ).astype(x.dtype)
+    else:
+        # dense gate map [B,T,E]: zeros except the top-k columns
+        gates = (w[..., None] * jax.nn.one_hot(idx, n_e, dtype=w.dtype)).sum(-2)
+
+        def expert_step(acc, xs):
+            e_i, egu, edown = xs
+            gate, up = mlp_ops.split_gate_up(linear_ops.linear(h, egu))
+            y = linear_ops.linear(
+                mlp_ops.gated_act_mul(gate, up, cfg.act), edown
+            )
+            return acc + y * gates[..., e_i, None].astype(y.dtype), None
+
+        out, _ = jax.lax.scan(
+            expert_step,
+            jnp.zeros_like(x),
+            (jnp.arange(n_e), lp["moe_gate_up"], lp["moe_down"]),
+        )
 
     if "shared_gate_up" in lp:  # qwen2-moe shared expert
         gate, up = mlp_ops.split_gate_up(

@@ -1,0 +1,305 @@
+"""Host-RAM expert offload — the FlashMoE equivalent.
+
+Reference counterpart: the ``flash-moe`` runtime (reference
+docs/mddocs/Quickstart/flashmoe_quickstart.md:20-25) which runs
+DeepSeek-671B / Qwen3MoE-235B on 1-2 GPUs by keeping experts in host RAM.
+BASELINE.md tracks "Mixtral-8x7B + DeepSeek-V2 MoE (expert offload)" as a
+functional config: Mixtral-8x7B INT4 is ~23 GB of experts against a 16 GB
+v5e chip, so the experts *cannot* all live in HBM.
+
+TPU-native design:
+
+- expert weight planes (the ``moe_gate_up`` / ``moe_down`` stacks) stay in
+  host RAM as packed numpy QTensors; everything else (attention, router,
+  norms, shared experts, embeddings) lives in HBM as usual;
+- an **HBM LRU cache** holds the hottest (layer, expert) entries under a
+  byte budget; a miss issues an async ``jax.device_put`` of the packed
+  planes (~4.5 bit/weight over PCIe) — dispatch returns immediately, so
+  the transfer overlaps the jitted attention of the same layer;
+- the forward is a **layer-by-layer Python drive** (not one jitted scan):
+  after each layer's router the top-k expert ids sync to the host, which
+  fetches exactly those experts.  This is the one host round-trip per
+  layer that data-dependent weight residency fundamentally requires — the
+  same structural trade the reference's FlashMoE binary makes.
+
+Throughput is PCIe/HBM-budget bound by construction; the point is the
+*capability*: models whose experts exceed HBM decode on a single chip.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.models.config import ModelConfig
+
+EXPERT_SLOTS = ("moe_gate_up", "moe_down")
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
+def _qt_nbytes(tree) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "size")
+    )
+
+
+class ExpertStore:
+    """Host-RAM packed expert store with an HBM LRU cache."""
+
+    def __init__(self, host_slots: dict[str, Any], hbm_budget_bytes: int):
+        self.host = host_slots            # slot -> stacked [L, E, ...] np QTensor
+        self.budget = hbm_budget_bytes
+        self._cache: OrderedDict[tuple, Any] = OrderedDict()
+        self._used = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, layer: int, expert: int) -> dict[str, Any]:
+        """Device QTensors {slot: qt} for one (layer, expert); LRU-cached."""
+        key = (layer, expert)
+        if key in self._cache:
+            self._cache.move_to_end(key)
+            self.hits += 1
+            return self._cache[key]
+        self.misses += 1
+        entry = {}
+        for slot, stacked in self.host.items():
+            per = jax.tree_util.tree_map(lambda a: a[layer, expert], stacked)
+            entry[slot] = jax.device_put(per)   # async dispatch
+        size = sum(_qt_nbytes(v) for v in entry.values())
+        while self._used + size > self.budget and self._cache:
+            _, old = self._cache.popitem(last=False)
+            self._used -= sum(_qt_nbytes(v) for v in old.values())
+        self._cache[key] = entry
+        self._used += size
+        return entry
+
+    def prefetch(self, layer: int, experts) -> None:
+        for e in experts:
+            self.get(layer, int(e))
+
+
+def split_expert_params(params: dict) -> tuple[dict, dict]:
+    """Move the expert stacks to host; return (device_params, host_slots)."""
+    layers = dict(params["layers"])
+    host = {}
+    for slot in EXPERT_SLOTS:
+        if slot in layers:
+            host[slot] = _to_host(layers.pop(slot))
+    out = dict(params)
+    out["layers"] = layers
+    return out, host
+
+
+# ---------------------------------------------------------------------------
+# jitted layer pieces (driven from Python per layer)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _embed(cfg: ModelConfig, params, tokens):
+    from ipex_llm_tpu.models.decoder import COMPUTE_DTYPE
+    from ipex_llm_tpu.ops.embedding import embed_lookup
+
+    x = embed_lookup(params["embed"], tokens, COMPUTE_DTYPE)
+    if cfg.embedding_multiplier != 1.0:
+        x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
+    return x
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _layer_attn_router(cfg: ModelConfig, layer, params, x, kl, vl,
+                       slot0, q_slots, kv_len, kv_start, cos, sin, sliding,
+                       cache):
+    """One layer's attention + router; returns the residual state, the
+    normalized FFN input, top-k (w, idx) and the updated KV planes.
+
+    ``layer`` is a *traced* index so all L layers share one compiled
+    program per (prefill, decode) shape."""
+    from ipex_llm_tpu.models import decoder as dec
+
+    lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+    attn_out, kl, vl, _ = dec._attention_block(
+        cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
+        sliding, cache, 0,
+    )
+    x = x + attn_out
+    h = dec._norm(x, lp["mlp_norm"], cfg)
+    router_logits = jnp.matmul(h.astype(jnp.float32), lp["router"])
+    k = cfg.num_experts_per_tok
+    if cfg.moe_softmax_before_topk:
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        w, idx = jax.lax.top_k(probs, k)
+        if cfg.moe_norm_topk_prob:
+            w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-20)
+    else:
+        lg, idx = jax.lax.top_k(router_logits, k)
+        w = jax.nn.softmax(lg, axis=-1)
+    if cfg.moe_router_scale != 1.0:
+        w = w * cfg.moe_router_scale
+    return x, h, w, idx, kl, vl
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_exp"))
+def _apply_experts(cfg: ModelConfig, n_exp: int, layer, params, x, h,
+                   gates, expert_qts):
+    """x += Σ_e gates[e] ⊙ expert_e(h) (+ shared expert), experts fetched.
+
+    gates [n_exp, B, T]; expert_qts: tuple of (gate_up, down) QTensor pairs.
+    """
+    from ipex_llm_tpu.ops import linear as linear_ops
+    from ipex_llm_tpu.ops import mlp as mlp_ops
+    from ipex_llm_tpu.ops.moe import _expert_ffn
+
+    y = jnp.zeros_like(x)
+    for i in range(n_exp):
+        gu, dn = expert_qts[i]
+        ye = _expert_ffn(h, gu, dn, cfg.act)
+        y = y + ye * gates[i][..., None].astype(ye.dtype)
+
+    lp = jax.tree_util.tree_map(lambda a: a[layer], params["layers"])
+    if "shared_gate_up" in lp:
+        gate, up = mlp_ops.split_gate_up(
+            linear_ops.linear(h, lp["shared_gate_up"])
+        )
+        ys = linear_ops.linear(
+            mlp_ops.gated_act_mul(gate, up, cfg.act), lp["shared_down"]
+        )
+        if "shared_router" in lp:
+            g = jax.nn.sigmoid(jnp.matmul(h.astype(jnp.float32),
+                                          lp["shared_router"]))
+            ys = ys * g.astype(ys.dtype)
+        y = y + ys
+    return x + y
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _final_logits(cfg: ModelConfig, params, x):
+    from ipex_llm_tpu.models import decoder as dec
+    from ipex_llm_tpu.ops import linear as linear_ops
+
+    x = dec._norm(x[:, -1:], params["final_norm"], cfg,
+                  params.get("final_norm_bias"))
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = jnp.matmul(
+            x.astype(dec.COMPUTE_DTYPE),
+            params["embed"].T.astype(dec.COMPUTE_DTYPE),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = linear_ops.linear(x, lm_head, params.get("lm_head_bias"))
+    logits = logits.astype(jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+    return logits[:, 0]
+
+
+class OffloadedMoE:
+    """Layer-by-layer MoE runtime with host-resident experts.
+
+    ``hbm_budget_mb`` caps the device-side expert cache; set it below the
+    total expert footprint to exercise real streaming (the Mixtral-on-16GB
+    regime).
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict,
+                 hbm_budget_mb: float = 4096.0):
+        if cfg.num_experts == 0:
+            raise ValueError("OffloadedMoE requires an MoE config")
+        self.cfg = cfg
+        self.params, host = split_expert_params(params)
+        self.store = ExpertStore(host, int(hbm_budget_mb * 1024 * 1024))
+
+    # -- forward ------------------------------------------------------------
+
+    def _forward(self, tokens: jnp.ndarray, caches, proto, slot0: int):
+        """tokens [1, T] through all layers; returns (logits [1,V], caches)."""
+        from ipex_llm_tpu.ops import rope as rope_ops
+
+        cfg = self.cfg
+        b, t = tokens.shape
+        x = _embed(cfg, self.params, tokens)
+        slot0_j = jnp.asarray(slot0, jnp.int32)
+        q_slots = jnp.broadcast_to(
+            slot0_j + jnp.arange(t)[None, :], (b, t)
+        )
+        kv_len = jnp.broadcast_to(slot0_j + t, (b,))
+        kv_start = jnp.zeros((b,), jnp.int32)
+        cos, sin = (None, None)
+        if cfg.rope is not None:
+            cos, sin = rope_ops.cos_sin(
+                q_slots, self.params["inv_freq"],
+                self.params.get("rope_mscale", 1.0),
+            )
+
+        for layer in range(cfg.num_layers):
+            kl, vl = caches[layer]
+            x, h, w, idx, kl, vl = _layer_attn_router(
+                cfg, jnp.asarray(layer, jnp.int32), self.params, x, kl, vl,
+                slot0_j, q_slots, kv_len, kv_start, cos, sin,
+                jnp.asarray(cfg.layer_is_sliding(layer)), proto,
+            )
+            caches[layer] = (kl, vl)
+            # host sync: which experts does this layer need?
+            idx_np = np.asarray(idx)            # [1, T, k]
+            w_np = np.asarray(w)
+            used = sorted(set(int(e) for e in idx_np.reshape(-1)))
+            # bucket the expert count so _apply_experts retraces only per
+            # power-of-two bucket, padding with a zero-weight repeat
+            n_exp = 1
+            while n_exp < len(used):
+                n_exp *= 2
+            gates = np.zeros((n_exp, b, t), np.float32)
+            for i, e in enumerate(used):
+                gates[i] = ((idx_np == e) * w_np).sum(-1)
+            qts = []
+            for i in range(n_exp):
+                e = used[i] if i < len(used) else used[0]
+                entry = self.store.get(layer, e)
+                qts.append((entry["moe_gate_up"], entry["moe_down"]))
+            x = _apply_experts(
+                cfg, n_exp, jnp.asarray(layer, jnp.int32), self.params, x, h,
+                jnp.asarray(gates), tuple(qts),
+            )
+        return _final_logits(cfg, self.params, x), caches
+
+    # -- public API ---------------------------------------------------------
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32):
+        """Greedy batch-1 decode with streamed experts.
+
+        Returns np.ndarray [1, len(prompt) + new]."""
+        from ipex_llm_tpu.kv import KVCache
+
+        cfg = self.cfg
+        prompt = np.asarray(prompt_ids, np.int32).reshape(1, -1)
+        t0 = prompt.shape[1]
+        cap = t0 + max_new_tokens + 8
+        full = KVCache.init(1, 1, cap, cfg.num_kv_heads, cfg.head_dim)
+        caches = [(full.k[0], full.v[0]) for _ in range(cfg.num_layers)]
+        # dtype/method provider only — tiny, so the per-layer jit doesn't
+        # haul a stacked cache around
+        from dataclasses import replace as _replace
+
+        proto = _replace(full, k=full.k[:1, :, :, :1], v=full.v[:1, :, :, :1])
+
+        logits, caches = self._forward(jnp.asarray(prompt), caches, proto, 0)
+        out = [int(np.asarray(jnp.argmax(logits, -1))[0])]
+        for step in range(1, max_new_tokens):
+            tok = jnp.asarray([[out[-1]]], jnp.int32)
+            logits, caches = self._forward(tok, caches, proto,
+                                           t0 + step - 1)
+            out.append(int(np.asarray(jnp.argmax(logits, -1))[0]))
+        return np.concatenate([prompt, np.asarray(out)[None]], axis=1)

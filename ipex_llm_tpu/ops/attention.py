@@ -108,6 +108,30 @@ def sdpa(
             scale=kwargs.get("scale"),
         )
 
+    mesh = dispatch.spmd_mesh()
+    if (
+        mesh is not None
+        and mesh.shape.get("tp", 1) > 1
+        and dispatch.use_pallas_sharded()
+        and q.shape[1] >= 128
+        and kwargs.get("bias") is None
+        and kwargs.get("causal", True)
+    ):
+        try:
+            from ipex_llm_tpu.ops.pallas import flash_attention
+
+            kw = dict(kwargs)
+            kw.pop("causal", None)
+            return flash_attention.flash_sdpa_sharded(
+                q, k, v, mesh,
+                q_positions=kw.pop("q_positions", None),
+                kv_len=kw.pop("kv_len", None),
+                kv_start=kw.pop("kv_start", None),
+                window_on=kw.pop("window_on", True),
+                causal=True, **kw,
+            )
+        except (ImportError, NotImplementedError):
+            pass
     if dispatch.use_pallas() and q.shape[1] >= 128 and kwargs.get("bias") is None:
         try:
             from ipex_llm_tpu.ops.pallas import flash_attention
@@ -116,3 +140,66 @@ def sdpa(
         except (ImportError, NotImplementedError):
             pass
     return sdpa_reference(q, k, v, **kwargs)
+
+
+def cached_sdpa(
+    q: jnp.ndarray,            # [B, T, Hq, D]
+    kl: jnp.ndarray,           # [B, Hkv, S, D] raw cache layer (maybe fp8)
+    vl: jnp.ndarray,
+    cache,
+    *,
+    compute_dtype=jnp.bfloat16,
+    **kwargs,
+) -> jnp.ndarray:
+    """SDPA over a cache layer in its *storage* layout and dtype.
+
+    Decode steps (T=1) route to the specialized Pallas kernel
+    (ops/pallas/decode_attention.py) which reads the head-major cache
+    natively — including fp8 tiles dequantized in-kernel, the
+    ``xe_addons.sdp_fp8`` equivalent (reference models/common.py:273-286).
+    Every other shape casts/permutes the layer once and uses the generic
+    :func:`sdpa` dispatch (XLA cancels the permute against the flash
+    kernel's own head-major view).
+    """
+    from ipex_llm_tpu.ops import dispatch
+
+    t = q.shape[1]
+    decode_ok = (
+        t == 1
+        and kwargs.get("bias") is None
+        and dispatch.ring_mesh() is None
+        and q.shape[2] % kl.shape[1] == 0
+    )
+    if decode_ok:
+        dk = dict(
+            scale=kwargs.get("scale"),
+            kv_len=kwargs.get("kv_len"),
+            kv_start=kwargs.get("kv_start"),
+            window=kwargs.get("window"),
+            window_on=kwargs.get("window_on", True),
+            softcap=kwargs.get("softcap"),
+        )
+        mesh = dispatch.spmd_mesh()
+        if mesh is None and dispatch.use_pallas():
+            try:
+                from ipex_llm_tpu.ops.pallas import decode_attention
+
+                return decode_attention.decode_sdpa(q, kl, vl, **dk)
+            except (ImportError, NotImplementedError):
+                pass
+        elif (
+            mesh is not None
+            and mesh.shape.get("tp", 1) > 1
+            and dispatch.use_pallas_sharded()
+        ):
+            try:
+                from ipex_llm_tpu.ops.pallas import decode_attention
+
+                return decode_attention.decode_sdpa_sharded(
+                    q, kl, vl, mesh, **dk
+                )
+            except (ImportError, NotImplementedError):
+                pass
+    kd = cache.decode_layer(kl, compute_dtype).transpose(0, 2, 1, 3)
+    vd = cache.decode_layer(vl, compute_dtype).transpose(0, 2, 1, 3)
+    return sdpa(q, kd, vd, **kwargs)

@@ -1,0 +1,138 @@
+"""Sparse Mixture-of-Experts dispatch.
+
+Reference counterparts: ``xe_linear.moe_forward_vec`` + ``moe_group_topk``
+(reference deepseek.py:293-322, models/common.py:342-375) and the FlashMoE
+CPU-offload runtime (docs/mddocs/Quickstart/flashmoe_quickstart.md).  The r2
+decoder computed EVERY expert on EVERY token (dense-compute MoE) — correct
+but E/k× wasted FLOPs and full-expert HBM traffic each step.
+
+TPU-native sparse design, all shapes static (SURVEY.md §7 hard part (b)):
+
+- **gather mode** (decode / tiny batches): for each (token, top-k) pair,
+  gather just that expert's packed weight planes from the stacked expert
+  QTensor with a dynamic index — XLA lowers to an HBM gather that reads
+  only the addressed experts, so decode weight traffic drops from E experts
+  to ≤ N·k (4× for Mixtral's E=8,k=2 at batch 1).
+- **capacity mode** (prefill / training): sort the (token, expert) pairs by
+  expert, scatter into a ``[E, C, H]`` bucket tensor (capacity
+  ``C = min(N, ceil(N·k/E · cf))``), run ONE vmapped expert computation
+  over the expert axis (a batched matmul GSPMD shards over ``ep`` with no
+  sequential scan), and scatter-add the weighted results back.  Tokens
+  beyond an expert's capacity are dropped (standard capacity-factor
+  semantics; cf defaults to 2.0 ⇒ drops only under >2× imbalance).
+
+The dense all-experts scan remains in models/decoder.py as the oracle and
+the fallback for odd configs (IPEX_LLM_TPU_DENSE_MOE=1).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+
+# pairs at or below this run gather mode (decode-shaped batches)
+GATHER_PAIR_LIMIT = 32
+
+
+def capacity_factor() -> float:
+    return float(os.environ.get("IPEX_LLM_TPU_MOE_CF", "2.0"))
+
+
+def use_sparse() -> bool:
+    return os.environ.get("IPEX_LLM_TPU_DENSE_MOE", "0") != "1"
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _take_expert(qt_or_arr, idx):
+    """Index the leading expert axis of a stacked weight (QTensor-aware)."""
+    return jax.tree_util.tree_map(lambda x: x[idx], qt_or_arr)
+
+
+def _expert_ffn(x, gate_up, down, act):
+    """x [..., H] through one expert's gated FFN (dequant fused by XLA)."""
+    gate, up = mlp_ops.split_gate_up(linear_ops.linear(x, gate_up))
+    return linear_ops.linear(mlp_ops.gated_act_mul(gate, up, act), down)
+
+
+def moe_gather(h, w, idx, gate_up, down, act):
+    """Per-pair expert gather: h [B,T,H], w/idx [B,T,k].
+
+    Weight traffic ∝ number of pairs, not E — the decode-path win.
+    """
+    b, t, hidden = h.shape
+    k = idx.shape[-1]
+    n = b * t
+    hf = h.reshape(n, hidden)
+    idx_f = idx.reshape(n * k)
+    w_f = w.reshape(n * k)
+    tok_f = jnp.repeat(jnp.arange(n), k)
+
+    pair_gu = _take_expert(gate_up, idx_f)     # [P, ...] packed planes
+    pair_dn = _take_expert(down, idx_f)
+    xi = hf[tok_f]                             # [P, H]
+
+    y = jax.vmap(
+        lambda x_, gu_, dn_: _expert_ffn(x_[None], gu_, dn_, act)[0]
+    )(xi, pair_gu, pair_dn)                    # [P, H]
+    y = y * w_f[:, None].astype(y.dtype)
+    out = jnp.zeros((n, hidden), y.dtype).at[tok_f].add(y)
+    return out.reshape(b, t, hidden)
+
+
+def moe_capacity(h, w, idx, gate_up, down, act, n_experts: int,
+                 cf: float | None = None):
+    """Capacity-bucketed sort dispatch: h [B,T,H], w/idx [B,T,k]."""
+    b, t, hidden = h.shape
+    k = idx.shape[-1]
+    n = b * t
+    cf = capacity_factor() if cf is None else cf
+    cap = min(n, _round_up(max(int(n * k / n_experts * cf), 1), 8))
+
+    hf = h.reshape(n, hidden)
+    e_f = idx.reshape(n * k)
+    w_f = w.reshape(n * k)
+    tok_f = jnp.repeat(jnp.arange(n), k)
+
+    order = jnp.argsort(e_f)                   # stable: token order per expert
+    e_sorted = e_f[order]
+    tok_sorted = tok_f[order]
+    w_sorted = w_f[order]
+    counts = jnp.bincount(e_f, length=n_experts)
+    starts = jnp.cumsum(counts) - counts       # exclusive prefix
+    pos_in_e = jnp.arange(n * k) - starts[e_sorted]
+    keep = pos_in_e < cap
+    # dropped pairs land in a scratch row past the real buckets
+    slot = jnp.where(keep, e_sorted * cap + pos_in_e, n_experts * cap)
+
+    x_buckets = jnp.zeros((n_experts * cap + 1, hidden), hf.dtype)
+    x_buckets = x_buckets.at[slot].set(hf[tok_sorted])
+    x_buckets = x_buckets[:-1].reshape(n_experts, cap, hidden)
+
+    y = jax.vmap(
+        lambda xe, gu_, dn_: _expert_ffn(xe, gu_, dn_, act)
+    )(x_buckets, gate_up, down)                # [E, C, H]
+
+    y_pairs = y.reshape(n_experts * cap, hidden)[
+        jnp.clip(slot, 0, n_experts * cap - 1)
+    ]
+    y_pairs = jnp.where(keep[:, None], y_pairs, 0.0)
+    y_pairs = y_pairs * w_sorted[:, None].astype(y_pairs.dtype)
+    out = jnp.zeros((n, hidden), y_pairs.dtype).at[tok_sorted].add(y_pairs)
+    return out.reshape(b, t, hidden)
+
+
+def moe_ffn(h, w, idx, gate_up, down, act, n_experts: int):
+    """Route to gather or capacity mode by static pair count."""
+    n_pairs = h.shape[0] * h.shape[1] * idx.shape[-1]
+    if n_pairs <= GATHER_PAIR_LIMIT:
+        return moe_gather(h, w, idx, gate_up, down, act)
+    return moe_capacity(h, w, idx, gate_up, down, act, n_experts)

@@ -211,3 +211,40 @@ def flash_sdpa(
         n_rep=n_rep,
     )
     return out.reshape(b, hq, t, dv).transpose(0, 2, 1, 3)
+
+
+def flash_sdpa_sharded(q, k, v, mesh, *, q_positions=None, kv_len=None,
+                       kv_start=None, window_on=True, **static_kwargs):
+    """Tensor-parallel flash SDPA: heads sharded over ``tp``, kernel runs
+    per-shard under ``jax.shard_map`` (attention is head-local, so no
+    collective; only ``tp`` is manual, dp/pp/cp stay under GSPMD)."""
+    from jax.sharding import PartitionSpec as P
+
+    b, t, hq, d = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    tp = mesh.shape["tp"]
+    if hq % tp or hkv % tp or (hq // tp) % (hkv // tp):
+        raise NotImplementedError("head counts must divide tp")
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None], (b, t)
+        )
+    if kv_len is None:
+        kv_len = jnp.full((b,), s, jnp.int32)
+    if kv_start is None:
+        kv_start = jnp.zeros((b,), jnp.int32)
+    won = jnp.broadcast_to(jnp.asarray(window_on, jnp.int32), (b,))
+
+    def run(ql, kl, vl, qpos, klen, kstart, wl):
+        return flash_sdpa(
+            ql, kl, vl, q_positions=qpos, kv_len=klen, kv_start=kstart,
+            window_on=wl, **static_kwargs,
+        )
+
+    hspec = P(None, None, "tp", None)
+    rep2, rep1 = P(None, None), P(None)
+    return jax.shard_map(
+        run, mesh=mesh, axis_names={"tp"},
+        in_specs=(hspec, hspec, hspec, rep2, rep1, rep1, rep1),
+        out_specs=hspec, check_vma=False,
+    )(q, k, v, q_positions.astype(jnp.int32), kv_len, kv_start, won)

@@ -169,7 +169,7 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
 
 def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int = 0,
                    n_layers: int = 0) -> NamedSharding:
-    """KV cache [L, B, S, Hkv, D]: layers over pp, batch over dp, heads over
+    """KV cache [L, B, Hkv, S, D]: layers over pp, batch over dp, heads over
     tp (when they divide; GQA with fewer kv heads than tp replicates)."""
     tp = mesh.shape.get("tp", 1)
     dp = mesh.shape.get("dp", 1)
@@ -177,7 +177,7 @@ def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int = 0,
     head_axis = "tp" if _divisible(n_kv_heads, tp) else None
     batch_axis = "dp" if _divisible(batch, dp) else None
     layer_axis = "pp" if pp > 1 and _divisible(n_layers, pp) else None
-    return NamedSharding(mesh, P(layer_axis, batch_axis, None, head_axis, None))
+    return NamedSharding(mesh, P(layer_axis, batch_axis, head_axis, None, None))
 
 
 def data_sharding(mesh: Mesh, batch: int = 0) -> NamedSharding:
@@ -189,7 +189,7 @@ def data_sharding(mesh: Mesh, batch: int = 0) -> NamedSharding:
 
 def shard_cache(cache, mesh: Mesh):
     """Place a KVCache pytree onto the mesh (k/v sharded, length replicated)."""
-    n_kv_heads = cache.k.shape[3]
+    n_kv_heads = cache.k.shape[2]
     batch = cache.k.shape[1]
     kv_sh = cache_sharding(mesh, n_kv_heads, batch, n_layers=cache.k.shape[0])
     rep = NamedSharding(mesh, P())

@@ -4,7 +4,7 @@ Design (TPU-first; contrast reference vllm/ + PPModelWorker
 pipeline_parallel.py:482-928 which rely on vLLM's paged attention):
 
 - a fixed pool of ``max_rows`` sequence rows sharing one static KV buffer
-  ``[L, R, S_max, H, D]`` — static shapes mean the decode step compiles
+  ``[L, R, H, S_max, D]`` — static shapes mean the decode step compiles
   exactly once;
 - every step decodes ALL rows in one jitted call; inactive rows are masked
   (their sampled token is ignored), so join/leave never recompiles;
@@ -94,16 +94,16 @@ def _insert_row(cache: KVCache, prefill_cache: KVCache, n_valid, row):
     """Copy a prefilled single-row cache (left-padded) into pool row ``row``
     at slot 0."""
     # valid slots of the prefill cache are [tpad - n, tpad); shift to 0
-    tpad = prefill_cache.k.shape[2]
+    tpad = prefill_cache.k.shape[3]
     start = tpad - n_valid
 
     def per_layer_copy(pool_buf, pre_buf):
-        # pool_buf [L,R,S,H,D]; pre_buf [L,1,Tpad,H,D]
-        src = jnp.roll(pre_buf[:, 0], -start, axis=1)       # valid now at 0
-        src = src[:, : pool_buf.shape[2]]                   # clip to S_max
-        pad = pool_buf.shape[2] - src.shape[1]
+        # pool_buf [L,R,H,S,D]; pre_buf [L,1,H,Tpad,D]
+        src = jnp.roll(pre_buf[:, 0], -start, axis=2)       # valid now at 0
+        src = src[:, :, : pool_buf.shape[3]]                # clip to S_max
+        pad = pool_buf.shape[3] - src.shape[2]
         if pad > 0:
-            src = jnp.pad(src, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            src = jnp.pad(src, ((0, 0), (0, 0), (0, pad), (0, 0)))
         return pool_buf.at[:, row].set(src.astype(pool_buf.dtype))
 
     return KVCache(

@@ -13,6 +13,7 @@ import pytest
 
 from ipex_llm_tpu.ops.attention import sdpa_reference
 from ipex_llm_tpu.ops.linear import qmatmul_reference
+from ipex_llm_tpu.ops.pallas.decode_attention import decode_sdpa
 from ipex_llm_tpu.ops.pallas.flash_attention import flash_sdpa
 from ipex_llm_tpu.ops.pallas.qmatmul import qmatmul_pallas
 from ipex_llm_tpu.quantize import quantize
@@ -93,6 +94,75 @@ def test_flash_softcap():
     want = np.asarray(sdpa_reference(q, k, v, softcap=30.0))
     got = np.asarray(flash_sdpa(q, k, v, softcap=30.0))
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_decode_sdpa_matches_reference():
+    """T=1 decode kernel vs the jnp oracle: GQA, left-pad kv_start, ragged
+    per-row lengths.  Kernel reads the head-major [B,Hkv,S,D] cache layout."""
+    b, s, hq, hkv, d = 3, 160, 8, 2, 64
+    q = jnp.asarray((RNG.standard_normal((b, 1, hq, d)) * 0.3).astype(np.float32))
+    k = jnp.asarray((RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32))
+    v = jnp.asarray((RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32))
+    kv_len = jnp.asarray([40, 100, 160], jnp.int32)
+    kv_start = jnp.asarray([5, 0, 32], jnp.int32)
+    qpos = (kv_len - 1)[:, None]
+    want = np.asarray(sdpa_reference(
+        q, k, v, causal=True, q_positions=qpos, kv_len=kv_len,
+        kv_start=kv_start,
+    ))
+    got = np.asarray(decode_sdpa(
+        q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        kv_len=kv_len, kv_start=kv_start,
+    ))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_decode_sdpa_fp8_kv_in_kernel():
+    """fp8(e5m2) KV tiles are widened inside the kernel — must match casting
+    the cache at the XLA level (the sdp_fp8 contract)."""
+    b, s, hq, hkv, d = 2, 128, 4, 4, 64
+    q = jnp.asarray((RNG.standard_normal((b, 1, hq, d)) * 0.3).astype(np.float32))
+    k8 = jnp.asarray(
+        (RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32)
+    ).astype(jnp.float8_e5m2)
+    v8 = jnp.asarray(
+        (RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32)
+    ).astype(jnp.float8_e5m2)
+    kv_len = jnp.asarray([64, 128], jnp.int32)
+    kv_start = jnp.zeros((b,), jnp.int32)
+    qpos = (kv_len - 1)[:, None]
+    want = np.asarray(sdpa_reference(
+        q, k8.astype(jnp.bfloat16), v8.astype(jnp.bfloat16),
+        causal=True, q_positions=qpos, kv_len=kv_len, kv_start=kv_start,
+    ))
+    got = np.asarray(decode_sdpa(
+        q, k8.transpose(0, 2, 1, 3), v8.transpose(0, 2, 1, 3),
+        kv_len=kv_len, kv_start=kv_start,
+    ))
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
+def test_decode_sdpa_window_and_softcap():
+    b, s, hq, hkv, d = 1, 96, 2, 2, 32
+    q = jnp.asarray((RNG.standard_normal((b, 1, hq, d)) * 0.3).astype(np.float32))
+    k = jnp.asarray((RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32))
+    v = jnp.asarray((RNG.standard_normal((b, s, hkv, d)) * 0.3).astype(np.float32))
+    kv_len = jnp.asarray([80], jnp.int32)
+    kv_start = jnp.zeros((b,), jnp.int32)
+    qpos = (kv_len - 1)[:, None]
+    for flag in (True, False):
+        won = jnp.asarray(flag)
+        want = np.asarray(sdpa_reference(
+            q, k, v, causal=True, q_positions=qpos, kv_len=kv_len,
+            kv_start=kv_start, window=24, window_on=won, softcap=30.0,
+        ))
+        got = np.asarray(decode_sdpa(
+            q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+            kv_len=kv_len, kv_start=kv_start, window=24,
+            window_on=won, softcap=30.0,
+        ))
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2,
+                                   err_msg=f"window_on={flag}")
 
 
 def test_flash_bf16_long_prefill():
