@@ -107,8 +107,33 @@ def build_params(
             if t is not None:
                 lp[key] = jnp.asarray(t, NORM_DTYPE)
 
+        # --- MLA (deepseek): low-rank q + compressed-kv projections; no
+        # qkv merge possible (kv_b applies to the compressed latent)
+        if scheme.kv_a is not None and cfg.is_mla:
+            if cfg.q_lora_rank is None:
+                lp["q"] = quantize_weight(get(name(scheme.q, i)), qtype)
+                qb = get_opt(name(scheme.q, i, "bias"))
+                if qb is not None:
+                    lp["q_bias"] = jnp.asarray(qb, jnp.float32)
+            else:
+                lp["q_a"] = quantize_weight(get(name(scheme.q_a, i)), qtype)
+                qab = get_opt(name(scheme.q_a, i, "bias"))
+                if qab is not None:
+                    lp["q_a_bias"] = jnp.asarray(qab, jnp.float32)
+                lp["q_a_norm"] = jnp.asarray(
+                    get(name(scheme.q_a_norm, i)), NORM_DTYPE
+                )
+                lp["q_b"] = quantize_weight(get(name(scheme.q_b, i)), qtype)
+            lp["kv_a"] = quantize_weight(get(name(scheme.kv_a, i)), qtype)
+            kab = get_opt(name(scheme.kv_a, i, "bias"))
+            if kab is not None:
+                lp["kv_a_bias"] = jnp.asarray(kab, jnp.float32)
+            lp["kv_a_norm"] = jnp.asarray(
+                get(name(scheme.kv_a_norm, i)), NORM_DTYPE
+            )
+            lp["kv_b"] = quantize_weight(get(name(scheme.kv_b, i)), qtype)
         # --- qkv (merge like reference _optimize_pre merge_qkv, convert.py:890)
-        if scheme.qkv is not None:
+        elif scheme.qkv is not None:
             qkv_w = get(name(scheme.qkv, i))
             qkv_b = get_opt(name(scheme.qkv, i, "bias"))
             if qkv_transform is not None:
@@ -124,9 +149,10 @@ def build_params(
             qkv_w = np.concatenate([qw, kw, vw], axis=0)  # [out_total, in]
             bs = [get_opt(name(t, i, "bias")) for t in (scheme.q, scheme.k, scheme.v)]
             qkv_b = np.concatenate(bs) if bs[0] is not None else None
-        lp["qkv"] = quantize_weight(qkv_w, qtype)
-        if qkv_b is not None:
-            lp["qkv_bias"] = jnp.asarray(qkv_b, jnp.float32)
+        if not (scheme.kv_a is not None and cfg.is_mla):
+            lp["qkv"] = quantize_weight(qkv_w, qtype)
+            if qkv_b is not None:
+                lp["qkv_bias"] = jnp.asarray(qkv_b, jnp.float32)
 
         ow = get(name(scheme.o, i))
         lp["o"] = quantize_weight(ow, qtype)
@@ -142,12 +168,12 @@ def build_params(
                     f"model has {cfg.num_experts} experts but the family "
                     "declares no MoE weight scheme"
                 )
-            if cfg.moe_layer_start != 0:
-                raise NotImplementedError(
-                    "dense-prefix MoE models (deepseek-style) not supported yet"
-                )
             rw = get(moe_scheme.router.format(i=i))          # [E, hidden]
             lp["router"] = jnp.asarray(np.ascontiguousarray(rw.T), jnp.float32)
+            if moe_scheme.score_bias is not None:
+                lp["router_bias"] = jnp.asarray(
+                    get(moe_scheme.score_bias.format(i=i)), jnp.float32
+                )
             e_gu, e_down = [], []
             for e in range(cfg.num_experts):
                 gw = get(moe_scheme.e_gate.format(i=i, e=e))
@@ -206,7 +232,16 @@ def build_params(
             lp["down_bias"] = jnp.asarray(db, jnp.float32)
         layers.append(lp)
 
-    params: dict[str, Any] = {"layers": stack_layer_trees(layers)}
+    # deepseek-style dense prefix: the first ``moe_layer_start`` layers have
+    # a plain MLP, the rest are MoE — two param stacks, two scans
+    # (decoder_forward); each stack is still one compiled layer body
+    if 0 < cfg.moe_layer_start < cfg.num_layers and cfg.num_experts > 0:
+        params = {
+            "layers_dense": stack_layer_trees(layers[: cfg.moe_layer_start]),
+            "layers": stack_layer_trees(layers[cfg.moe_layer_start :]),
+        }
+    else:
+        params = {"layers": stack_layer_trees(layers)}
     if embedding_qtype and not cfg.tie_word_embeddings:
         # LowBitEmbedding equivalent (reference embedding.py:179): table
         # quantized [vocab, hidden] with vocab as the block axis; rows

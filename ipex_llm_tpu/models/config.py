@@ -72,6 +72,23 @@ class ModelConfig:
     # deepseek); False = top-k logits then softmax over the k (mixtral)
     moe_softmax_before_topk: bool = True
     moe_shared_expert_gate: bool = False  # qwen2-moe sigmoid shared gate
+    # deepseek group-limited routing (reference deepseek.py moe_group_topk):
+    # experts split into n_group groups; only topk_group groups are eligible
+    moe_n_group: int = 0
+    moe_topk_group: int = 0
+    moe_score_func: str = "softmax"   # softmax (v2) | sigmoid (v3 noaux_tc)
+    moe_group_score: str = "max"      # max (v2) | top2sum (v3)
+    moe_score_bias: bool = False      # v3 e_score_correction_bias buffer
+
+    # MLA — DeepSeek multi-head latent attention (reference deepseek.py:
+    # 274-343; unbalanced-head cache kv.py:155).  head_dim is the FULL qk
+    # head dim (nope+rope); the cache stores K at head_dim and V at
+    # v_head_dim (k != v dims — the "unbalanced" cache).
+    q_lora_rank: int | None = None
+    kv_lora_rank: int | None = None
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int | None = None     # defaults to head_dim
 
     def layer_is_sliding(self, layer_idx: int) -> bool:
         if self.layer_types is not None:
@@ -88,3 +105,12 @@ class ModelConfig:
     @property
     def kv_dim(self) -> int:
         return self.num_kv_heads * self.head_dim
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank is not None
+
+    @property
+    def v_dim(self) -> int:
+        """Per-head V dim (== head_dim except MLA's unbalanced cache)."""
+        return self.v_head_dim if self.v_head_dim is not None else self.head_dim

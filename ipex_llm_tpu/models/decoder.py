@@ -56,6 +56,52 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
     b, t, _ = x.shape
     h = _in_norm(x, lp, "attn_norm", cfg)
     q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
+    if cfg.is_mla:
+        # DeepSeek MLA (reference deepseek.py:274-343): low-rank q, a
+        # compressed KV latent with a shared (MQA-like) rope slice, and an
+        # unbalanced cache — K at qk dim (nope+rope), V at v_head_dim.
+        nope, rd_pe = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+        if "q_a" in lp:
+            qa = linear_ops.linear(h, lp["q_a"], lp.get("q_a_bias"))
+            q = linear_ops.linear(
+                rms_norm(qa, lp["q_a_norm"], cfg.norm_eps), lp["q_b"]
+            )
+        else:  # V2-Lite: full-rank q_proj
+            q = linear_ops.linear(h, lp["q"], lp.get("q_bias"))
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+        ckv = linear_ops.linear(h, lp["kv_a"], lp.get("kv_a_bias"))
+        c = rms_norm(ckv[..., : cfg.kv_lora_rank], lp["kv_a_norm"],
+                     cfg.norm_eps)
+        k_pe = ckv[..., cfg.kv_lora_rank :][:, :, None, :]  # [B,T,1,rd]
+        kv = linear_ops.linear(c, lp["kv_b"]).reshape(
+            b, t, cfg.num_heads, nope + cfg.v_dim
+        )
+        k_nope, v = kv[..., :nope], kv[..., nope:]
+
+        q_pe = rope_ops.apply_rope(q_pe, cos, sin, "two")
+        k_pe = rope_ops.apply_rope(k_pe, cos, sin, "two")
+        q = jnp.concatenate([q_nope, q_pe], axis=-1)
+        k = jnp.concatenate(
+            [k_nope,
+             jnp.broadcast_to(k_pe, (b, t, cfg.num_heads, rd_pe))],
+            axis=-1,
+        )
+
+        obs_q = q[:, -collect_obs:] if collect_obs else jnp.zeros((0,), x.dtype)
+        kl, vl = cache.update_layer(kl, vl, k, v, slot0)
+        attn = cached_sdpa(
+            q, kl, vl, cache,
+            compute_dtype=COMPUTE_DTYPE, causal=True, q_positions=q_slots,
+            kv_len=kv_len, kv_start=kv_start, window=None, window_on=sliding,
+            softcap=cfg.attn_softcap, scale=cfg.attn_scale,
+        )
+        attn = attn.reshape(b, t, cfg.num_heads * cfg.v_dim)
+        out = linear_ops.linear(attn, lp["o"], lp.get("o_bias"))
+        if cfg.post_attn_norm:
+            out = _norm(out, lp["post_attn_norm"], cfg)
+        return out, kl, vl, obs_q
     if "qkv" in lp:
         qkv = linear_ops.linear(h, lp["qkv"], lp.get("qkv_bias"))
         q = qkv[..., :q_dim]
@@ -136,10 +182,31 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
     k = cfg.num_experts_per_tok
     n_e = cfg.num_experts
     if cfg.moe_softmax_before_topk:
-        probs = jax.nn.softmax(router_logits, axis=-1)
-        w, idx = jax.lax.top_k(probs, k)
+        if cfg.moe_score_func == "sigmoid":  # deepseek-v3 noaux_tc
+            scores = jax.nn.sigmoid(router_logits)
+        else:
+            scores = jax.nn.softmax(router_logits, axis=-1)
+        sel = scores
+        if "router_bias" in lp:  # v3 e_score_correction_bias: selection
+            sel = sel + lp["router_bias"]  # only; weights use raw scores
+        if cfg.moe_n_group > 1:
+            # group-limited routing (deepseek group_limited_greedy /
+            # noaux_tc): only experts in the top ``topk_group`` groups are
+            # eligible; group score is the max (v2) or top-2 sum (v3) of
+            # its members
+            g = sel.reshape(*sel.shape[:-1], cfg.moe_n_group, -1)
+            if cfg.moe_group_score == "top2sum":
+                gs = jax.lax.top_k(g, 2)[0].sum(-1)
+            else:
+                gs = g.max(-1)
+            _, gidx = jax.lax.top_k(gs, cfg.moe_topk_group)
+            gmask = jax.nn.one_hot(gidx, cfg.moe_n_group, dtype=sel.dtype
+                                   ).sum(-2)
+            sel = jnp.where(gmask[..., None] > 0, g, 0.0).reshape(sel.shape)
+        _, idx = jax.lax.top_k(sel, k)
+        w = jnp.take_along_axis(scores, idx, axis=-1)
         if cfg.moe_norm_topk_prob:
-            w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-20)
+            w = w / (w.sum(-1, keepdims=True) + 1e-20)
     else:  # mixtral: top-k logits, softmax over the k
         lg, idx = jax.lax.top_k(router_logits, k)
         w = jax.nn.softmax(lg, axis=-1)
@@ -279,9 +346,28 @@ def decoder_forward(
             x = x + ffn(cfg, lp, x)
         return x, (kl, vl, obs_q)
 
-    x, (k_new, v_new, obs_q) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v, sliding_flags)
-    )
+    # deepseek-style dense-prefix models carry two layer stacks (plain-MLP
+    # prefix + MoE rest, models/build.py); each runs its own scan over its
+    # cache slice so every stack still compiles one layer body
+    if "layers_dense" in params:
+        nd = cfg.moe_layer_start
+        stacks = [(params["layers_dense"], 0, nd),
+                  (params["layers"], nd, cfg.num_layers)]
+    else:
+        stacks = [(params["layers"], 0, cfg.num_layers)]
+    k_parts, v_parts, obs_parts = [], [], []
+    for tree, lo, hi in stacks:
+        x, (kp, vp, op) = jax.lax.scan(
+            body, x, (tree, cache.k[lo:hi], cache.v[lo:hi],
+                      sliding_flags[lo:hi])
+        )
+        k_parts.append(kp)
+        v_parts.append(vp)
+        obs_parts.append(op)
+    k_new = jnp.concatenate(k_parts) if len(k_parts) > 1 else k_parts[0]
+    v_new = jnp.concatenate(v_parts) if len(v_parts) > 1 else v_parts[0]
+    obs_q = (jnp.concatenate(obs_parts) if len(obs_parts) > 1
+             else obs_parts[0])
 
     x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
 

@@ -42,6 +42,13 @@ class WeightScheme:
     down: str = "model.layers.{i}.mlp.down_proj.{p}"
     q_norm: str | None = None
     k_norm: str | None = None
+    # MLA (deepseek): q (or q_a/q_b low-rank pair), kv_a, kv_b replace q/k/v
+    q_a: str | None = None
+    q_a_norm: str | None = None
+    q_b: str | None = None
+    kv_a: str | None = None
+    kv_a_norm: str | None = None
+    kv_b: str | None = None
 
 
 @dataclass(frozen=True)
@@ -56,6 +63,7 @@ class MoEScheme:
     shared_up: str | None = None
     shared_down: str | None = None
     shared_router: str | None = None  # qwen2-moe shared_expert_gate
+    score_bias: str | None = None     # deepseek-v3 e_score_correction_bias
 
 
 @dataclass(frozen=True)
@@ -218,6 +226,114 @@ def _qwen3_moe(hf: dict) -> ModelConfig:
     ))
 
 
+def _glm(hf: dict) -> ModelConfig:
+    """GLM-4 (HF mainline ``glm``): interleaved half-rotary rope, merged
+    gate_up MLP, QKV bias.  Reference counterpart: chatglm2/4 patches
+    (transformers/models/chatglm2.py, chatglm4.py)."""
+    hf2 = dict(hf)
+    hf2.setdefault("partial_rotary_factor", 0.5)
+    hf2.setdefault("head_dim", 128)
+    return ModelConfig(**_base_cfg(
+        hf2,
+        rope_layout="two",
+        attention_bias=hf.get("attention_bias", True),
+        attention_out_bias=False,
+    ))
+
+
+def _glm4(hf: dict) -> ModelConfig:
+    from dataclasses import replace
+    return replace(_glm(hf), post_attn_norm=True, post_mlp_norm=True)
+
+
+def _chatglm(hf: dict) -> ModelConfig:
+    """Legacy THUDM ``chatglm`` checkpoints (chatglm2/3-6b, glm-4-9b-chat):
+    same math as mainline glm, different config keys and weight names
+    (reference chatglm2.py:118-183 config usage)."""
+    if not hf.get("rmsnorm", True) or hf.get("post_layer_norm") is False:
+        raise NotImplementedError("layernorm/post-norm chatglm variants (v1)")
+    head_dim = hf.get("kv_channels",
+                      hf["hidden_size"] // hf["num_attention_heads"])
+    groups = (hf.get("multi_query_group_num", hf["num_attention_heads"])
+              if hf.get("multi_query_attention", False)
+              else hf["num_attention_heads"])
+    hf2 = dict(
+        model_type="chatglm",
+        vocab_size=hf.get("padded_vocab_size", hf.get("vocab_size")),
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["ffn_hidden_size"],
+        num_hidden_layers=hf["num_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        num_key_value_heads=groups,
+        head_dim=head_dim,
+        max_position_embeddings=hf.get("seq_length", 8192),
+        rms_norm_eps=hf.get("layernorm_epsilon", 1e-5),
+        rope_theta=10000.0 * hf.get("rope_ratio", 1.0),
+        partial_rotary_factor=0.5,
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+    )
+    return ModelConfig(**_base_cfg(
+        hf2,
+        rope_layout="two",
+        attention_bias=hf.get("add_qkv_bias", hf.get("add_bias_linear", False)),
+        attention_out_bias=hf.get("add_bias_linear", False),
+        mlp_bias=hf.get("add_bias_linear", False),
+    ))
+
+
+def _deepseek_common(hf: dict) -> dict:
+    qk_dim = (hf.get("qk_nope_head_dim", 128) + hf.get("qk_rope_head_dim", 64)
+              if hf.get("kv_lora_rank") else
+              hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"])
+    hf2 = dict(hf)
+    if hf.get("kv_lora_rank"):
+        hf2["head_dim"] = qk_dim
+        # rope acts on the 64-dim rope slice only; naive cache is per-head
+        hf2["num_key_value_heads"] = hf["num_attention_heads"]
+    d = _base_cfg(
+        hf2,
+        rope_layout="two",
+        q_lora_rank=hf.get("q_lora_rank"),
+        kv_lora_rank=hf.get("kv_lora_rank"),
+        qk_nope_head_dim=hf.get("qk_nope_head_dim", 0),
+        qk_rope_head_dim=hf.get("qk_rope_head_dim", 0),
+        v_head_dim=hf.get("v_head_dim"),
+        num_experts=hf.get("n_routed_experts") or 0,
+        num_experts_per_tok=hf.get("num_experts_per_tok") or 0,
+        moe_intermediate_size=hf.get("moe_intermediate_size", 0),
+        num_shared_experts=hf.get("n_shared_experts") or 0,
+        moe_layer_start=hf.get("first_k_dense_replace", 0),
+        moe_router_scale=hf.get("routed_scaling_factor", 1.0),
+        moe_norm_topk_prob=hf.get("norm_topk_prob", False),
+        moe_softmax_before_topk=True,
+    )
+    if hf.get("kv_lora_rank"):
+        # rope table spans the rope slice; attention scales by full qk dim
+        d["rope"] = _rope_from_hf(hf, hf.get("qk_rope_head_dim", 64))
+        d["attn_scale"] = qk_dim ** -0.5
+    return d
+
+
+def _deepseek_v2(hf: dict) -> ModelConfig:
+    d = _deepseek_common(hf)
+    if hf.get("topk_method", "greedy") == "group_limited_greedy":
+        d.update(moe_n_group=hf.get("n_group") or 0,
+                 moe_topk_group=hf.get("topk_group") or 0)
+    return ModelConfig(**d)
+
+
+def _deepseek_v3(hf: dict) -> ModelConfig:
+    d = _deepseek_common(hf)
+    d.update(
+        moe_n_group=hf.get("n_group") or 0,
+        moe_topk_group=hf.get("topk_group") or 0,
+        moe_score_func="sigmoid",
+        moe_group_score="top2sum",
+        moe_score_bias=True,
+    )
+    return ModelConfig(**d)
+
+
 def _phi(hf: dict) -> ModelConfig:
     """phi-1/phi-2: parallel attn+mlp off ONE shared layernorm, partial
     rotary, non-gated gelu MLP, biases everywhere."""
@@ -341,6 +457,49 @@ _INTERNLM2_SCHEME = WeightScheme(
     down="model.layers.{i}.feed_forward.w2.{p}",
 )
 
+_GLM_SCHEME = WeightScheme(
+    gate=None, up=None,
+    gate_up="model.layers.{i}.mlp.gate_up_proj.{p}",
+)
+_GLM4_SCHEME = WeightScheme(
+    gate=None, up=None,
+    gate_up="model.layers.{i}.mlp.gate_up_proj.{p}",
+    post_attn_norm="model.layers.{i}.post_self_attn_layernorm.weight",
+    post_mlp_norm="model.layers.{i}.post_mlp_layernorm.weight",
+)
+_CHATGLM_SCHEME = WeightScheme(
+    embed="transformer.embedding.word_embeddings.weight",
+    final_norm="transformer.encoder.final_layernorm.weight",
+    lm_head="transformer.output_layer.weight",
+    attn_norm="transformer.encoder.layers.{i}.input_layernorm.weight",
+    mlp_norm="transformer.encoder.layers.{i}.post_attention_layernorm.weight",
+    qkv="transformer.encoder.layers.{i}.self_attention.query_key_value.{p}",
+    q=None, k=None, v=None,
+    o="transformer.encoder.layers.{i}.self_attention.dense.{p}",
+    gate=None, up=None,
+    gate_up="transformer.encoder.layers.{i}.mlp.dense_h_to_4h.{p}",
+    down="transformer.encoder.layers.{i}.mlp.dense_4h_to_h.{p}",
+)
+_DEEPSEEK_SCHEME = WeightScheme(
+    k=None, v=None,  # q template serves the V2-Lite full-rank q_proj
+    q_a="model.layers.{i}.self_attn.q_a_proj.{p}",
+    q_a_norm="model.layers.{i}.self_attn.q_a_layernorm.weight",
+    q_b="model.layers.{i}.self_attn.q_b_proj.{p}",
+    kv_a="model.layers.{i}.self_attn.kv_a_proj_with_mqa.{p}",
+    kv_a_norm="model.layers.{i}.self_attn.kv_a_layernorm.weight",
+    kv_b="model.layers.{i}.self_attn.kv_b_proj.{p}",
+)
+_DEEPSEEK_MOE = MoEScheme(
+    shared_gate="model.layers.{i}.mlp.shared_experts.gate_proj.weight",
+    shared_up="model.layers.{i}.mlp.shared_experts.up_proj.weight",
+    shared_down="model.layers.{i}.mlp.shared_experts.down_proj.weight",
+)
+_DEEPSEEK_V3_MOE = MoEScheme(
+    shared_gate="model.layers.{i}.mlp.shared_experts.gate_proj.weight",
+    shared_up="model.layers.{i}.mlp.shared_experts.up_proj.weight",
+    shared_down="model.layers.{i}.mlp.shared_experts.down_proj.weight",
+    score_bias="model.layers.{i}.mlp.gate.e_score_correction_bias",
+)
 _MIXTRAL_MOE = MoEScheme(
     router="model.layers.{i}.block_sparse_moe.gate.weight",
     e_gate="model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
@@ -384,6 +543,13 @@ FAMILIES: dict[str, Family] = {
     "baichuan": Family("baichuan", _baichuan, _BAICHUAN_SCHEME),
     "internlm2": Family("internlm2", _internlm2, _INTERNLM2_SCHEME,
                         qkv_transform=_internlm2_qkv),
+    "glm": Family("glm", _glm, _GLM_SCHEME),
+    "glm4": Family("glm4", _glm4, _GLM4_SCHEME),
+    "chatglm": Family("chatglm", _chatglm, _CHATGLM_SCHEME),
+    "deepseek_v2": Family("deepseek_v2", _deepseek_v2, _DEEPSEEK_SCHEME,
+                          _DEEPSEEK_MOE),
+    "deepseek_v3": Family("deepseek_v3", _deepseek_v3, _DEEPSEEK_SCHEME,
+                          _DEEPSEEK_V3_MOE),
     "mixtral": Family("mixtral", _mixtral, WeightScheme(), _MIXTRAL_MOE),
     "qwen2_moe": Family("qwen2_moe", _qwen2_moe, WeightScheme(), _QWEN2_MOE),
     "qwen3_moe": Family(

@@ -287,7 +287,8 @@ class OffloadedMoE:
         prompt = np.asarray(prompt_ids, np.int32).reshape(1, -1)
         t0 = prompt.shape[1]
         cap = t0 + max_new_tokens + 8
-        full = KVCache.init(1, 1, cap, cfg.num_kv_heads, cfg.head_dim)
+        full = KVCache.init(1, 1, cap, cfg.num_kv_heads, cfg.head_dim,
+                            v_head_dim=cfg.v_dim)
         caches = [(full.k[0], full.v[0]) for _ in range(cfg.num_layers)]
         # dtype/method provider only — tiny, so the per-layer jit doesn't
         # haul a stacked cache around

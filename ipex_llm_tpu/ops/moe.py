@@ -88,6 +88,56 @@ def moe_gather(h, w, idx, gate_up, down, act):
     return out.reshape(b, t, hidden)
 
 
+def _dequant_stack(qt_or_arr):
+    """Stacked expert weight [E, ...] -> dense [E, K, N] bf16."""
+    from ipex_llm_tpu.quantize import core as qcore
+    from ipex_llm_tpu.quantize.core import QTensor
+
+    if isinstance(qt_or_arr, QTensor):
+        return jax.vmap(qcore.dequantize)(qt_or_arr).astype(jnp.bfloat16)
+    return qt_or_arr.astype(jnp.bfloat16)
+
+
+def moe_ragged(h, w, idx, gate_up, down, act, n_experts: int):
+    """Exact sorted dispatch via ``lax.ragged_dot`` (MXU group-gemm).
+
+    Tokens sort by expert and run ONE ragged matmul per projection over
+    the expert-major dense weight stack — exact results (no capacity
+    drops), FLOPs proportional to routed pairs, one pass of expert
+    weight traffic (the same traffic dense-all-experts pays, E/k fewer
+    FLOPs).  This is the single-mesh prefill path; the capacity-bucketed
+    form below remains for ``ep``-sharded meshes where the expert axis
+    is partitioned.
+    """
+    b, t, hidden = h.shape
+    k = idx.shape[-1]
+    n = b * t
+    hf = h.reshape(n, hidden).astype(jnp.bfloat16)
+    e_f = idx.reshape(n * k)
+    w_f = w.reshape(n * k)
+    tok_f = jnp.repeat(jnp.arange(n), k)
+
+    order = jnp.argsort(e_f)
+    tok_s = tok_f[order]
+    w_s = w_f[order]
+    counts = jnp.bincount(e_f, length=n_experts)
+
+    x = hf[tok_s]                                   # [P, H]
+    gu = _dequant_stack(gate_up)                    # [E, H, 2I]
+    inner = jax.lax.ragged_dot(
+        x, gu, counts, preferred_element_type=jnp.float32
+    )
+    gate, up = mlp_ops.split_gate_up(inner)
+    act_x = mlp_ops.gated_act_mul(gate, up, act).astype(jnp.bfloat16)
+    dn = _dequant_stack(down)                       # [E, I, H]
+    y = jax.lax.ragged_dot(
+        act_x, dn, counts, preferred_element_type=jnp.float32
+    )
+    y = y * w_s[:, None].astype(y.dtype)
+    out = jnp.zeros((n, hidden), y.dtype).at[tok_s].add(y)
+    return out.reshape(b, t, hidden).astype(h.dtype)
+
+
 def moe_capacity(h, w, idx, gate_up, down, act, n_experts: int,
                  cf: float | None = None):
     """Capacity-bucketed sort dispatch: h [B,T,H], w/idx [B,T,k]."""
@@ -131,8 +181,14 @@ def moe_capacity(h, w, idx, gate_up, down, act, n_experts: int,
 
 
 def moe_ffn(h, w, idx, gate_up, down, act, n_experts: int):
-    """Route to gather or capacity mode by static pair count."""
+    """Route by static pair count and mesh: gather (decode), ragged
+    group-gemm (exact, single-mesh prefill), capacity buckets (ep)."""
+    from ipex_llm_tpu.ops import dispatch
+
     n_pairs = h.shape[0] * h.shape[1] * idx.shape[-1]
     if n_pairs <= GATHER_PAIR_LIMIT:
         return moe_gather(h, w, idx, gate_up, down, act)
-    return moe_capacity(h, w, idx, gate_up, down, act, n_experts)
+    mesh = dispatch.spmd_mesh()
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        return moe_capacity(h, w, idx, gate_up, down, act, n_experts)
+    return moe_ragged(h, w, idx, gate_up, down, act, n_experts)

@@ -29,7 +29,8 @@ def causal_lm_loss(
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy over the batch (fp32 softmax)."""
     b, t = tokens.shape
-    cache = KVCache.init(cfg.num_layers, b, t, cfg.num_kv_heads, cfg.head_dim)
+    cache = KVCache.init(cfg.num_layers, b, t, cfg.num_kv_heads,
+                         cfg.head_dim, v_head_dim=cfg.v_dim)
     pos = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     logits, _ = decoder_forward(cfg, params, tokens, cache, pos)
     logits = logits[:, :-1].astype(jnp.float32)

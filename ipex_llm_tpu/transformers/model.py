@@ -210,6 +210,7 @@ class TPUModelForCausalLM:
         cache = make_cache(
             "normal", self.config.num_layers, b, max(t, 1),
             self.config.num_kv_heads, self.config.head_dim,
+            v_head_dim=self.config.v_dim,
         )
         pos = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
         tokens_j = jnp.asarray(tokens)
@@ -315,6 +316,7 @@ class TPUModelForCausalLM:
         self.rest_cost_mean = res.rest_token_s
         self.n_matched = getattr(res, "n_matched", 0)
         self.n_drafted = getattr(res, "n_drafted", 0)
+        self.last_result = res
         out = res.sequences
         if was_torch:
             import torch
