@@ -88,6 +88,7 @@ class GenerateResult:
     n_rounds: int = 0
     n_drafted: int = 0
     n_matched: int = 0
+    th_stop_draft: float = 0.0     # final auto-tuned draft-stop threshold
 
 
 def _round_up(n: int, m: int) -> int:
@@ -291,12 +292,13 @@ def generate(
         capacity = tpad
         cache = kv_mod.make_cache(
             "normal", cfg.num_layers, b, capacity, cfg.num_kv_heads,
-            cfg.head_dim,
+            cfg.head_dim, v_head_dim=cfg.v_dim,
         )
     else:
         capacity = tpad + _round_up(gen.max_new_tokens + 1, DECODE_BLOCK)
         cache = kv_mod.make_cache(
-            kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads, cfg.head_dim
+            kv_kind, cfg.num_layers, b, capacity, cfg.num_kv_heads,
+            cfg.head_dim, v_head_dim=cfg.v_dim,
         )
 
     from ipex_llm_tpu.ops import dispatch as _dispatch

@@ -77,6 +77,31 @@ def sample_rows(logits: jnp.ndarray, temps: jnp.ndarray, top_ps: jnp.ndarray,
     return jnp.where(temps > 0, sampled, greedy)
 
 
+def _transform_logits(logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:
+    """The temperature/top-k/top-p transform chain shared by ``sample`` and
+    ``transformed_probs`` — ONE definition, because speculative rejection
+    sampling is distribution-identical to plain sampling only while the two
+    stay byte-for-byte the same."""
+    logits = logits.astype(jnp.float32)
+    if params.temperature not in (0.0, 1.0):
+        logits = logits / params.temperature
+    if params.top_k > 0:
+        logits = _top_k_mask(logits, params.top_k)
+    if params.top_p < 1.0:
+        logits = _top_p_mask(logits, params.top_p)
+    return logits
+
+
+def transformed_probs(logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:
+    """Post-transform (temperature/top-k/top-p) probability rows.
+
+    The distribution ``sample`` draws from, materialized — used by
+    speculative rejection-sampling verification, where both the draft's
+    proposal q and the target's p must be actual distributions.
+    """
+    return jax.nn.softmax(_transform_logits(logits, params), axis=-1)
+
+
 def sample(
     logits: jnp.ndarray,           # [B, V]
     key: jax.Array,
@@ -89,10 +114,5 @@ def sample(
         logits = apply_repetition_penalty(logits, prev_tokens, params.repetition_penalty)
     if not params.do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if params.temperature not in (0.0, 1.0):
-        logits = logits / params.temperature
-    if params.top_k > 0:
-        logits = _top_k_mask(logits, params.top_k)
-    if params.top_p < 1.0:
-        logits = _top_p_mask(logits, params.top_p)
+    logits = _transform_logits(logits, params)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

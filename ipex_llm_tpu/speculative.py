@@ -21,8 +21,10 @@ TPU-native redesign (one XLA program, zero host syncs per round):
 - prompt-lookup runs the same verify loop with the draft forward replaced by
   a vectorized n-gram scan over the generated-so-far ring.
 
-Greedy only (the reference's benchmark path): with greedy verification the
-output is guaranteed token-identical to plain target-model decoding.
+Verification: greedy (token-identical to plain decoding) or rejection
+sampling (distribution-identical to plain target sampling); the draft leg
+stops early on low confidence with the reference's auto-tuned
+``th_stop_draft`` (speculative.py:811-812) carried in loop state.
 """
 
 from __future__ import annotations
@@ -64,7 +66,8 @@ def _forward_at(cfg, params, cache, seq_buf, start, t: int, length):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "draft_cfg", "k", "max_new", "eos_ids", "ngram"),
+    static_argnames=("cfg", "draft_cfg", "k", "max_new", "eos_ids", "ngram",
+                     "sp", "adaptive"),
 )
 def _spec_loop(
     cfg: ModelConfig,
@@ -75,45 +78,98 @@ def _spec_loop(
     draft_cache,                 # draft cache (unused in lookup mode)
     seq_buf: jnp.ndarray,        # [1, S] prompt + first token at n_p
     n_prompt: jnp.ndarray,       # scalar: prompt length
+    key: jax.Array,
+    th0: jnp.ndarray,            # f32 scalar: initial th_stop_draft
     k: int,
     max_new: int,
     eos_ids: tuple[int, ...],
     ngram: int = 2,
+    sp=None,                     # SamplingParams; do_sample selects the
+                                 # rejection-sampling verifier
+    adaptive: bool = True,
 ):
     """Speculative rounds until max_new tokens (or EOS).  Returns
-    (seq_buf, n_generated, n_rounds, n_drafted, n_matched)."""
+    (seq_buf, n_generated, n_rounds, n_drafted, n_matched, th_final).
+
+    Verification modes (reference speculative.py:805-1100):
+    - greedy: accept the longest prefix where draft == target argmax —
+      token-identical to plain decoding.
+    - sampling: per-token rejection sampling — accept x with prob
+      min(1, p(x)/q(x)); on reject draw from normalize(max(p-q, 0)); if
+      every draft survives, draw the bonus token from p_{k+1}.  The
+      output distribution provably equals plain target sampling.
+
+    Adaptive drafting: the draft leg is a ``lax.while_loop`` that stops
+    early when the draft's own confidence in its last token falls below a
+    threshold carried in loop state — the reference's ``th_stop_draft``
+    with its accept-rate auto-tuning (speculative.py:811-812,
+    auto_th_stop_draft) — so low-confidence rounds don't burn k draft
+    forwards.  All shapes stay static; only trip counts vary.
+    """
     eos = jnp.asarray(eos_ids, jnp.int32) if eos_ids else None
     s_max = seq_buf.shape[1]
+    vocab = cfg.vocab_size
+    sampling = sp is not None and sp.do_sample
 
     def is_eos(t):
         if eos is None:
             return jnp.zeros(jnp.shape(t), bool)
         return (t[..., None] == eos).any(-1)
 
-    def draft_model_candidates(seq, n, draft_cache):
-        """Draft k tokens with the draft model (self-speculative path)."""
+    def dist(logits):  # [.., V] target/draft distribution (post-transform)
+        from ipex_llm_tpu.ops.sampling import transformed_probs
+
+        return transformed_probs(logits, sp)
+
+    def pick(lg, subkey):
+        """Draft token + its proposal-prob row from one logits row [1,V]."""
+        if sampling:
+            qrow = dist(lg)[0]                       # [V]
+            tok = jax.random.categorical(subkey, jnp.log(qrow + 1e-30))
+            tok = tok.astype(jnp.int32)
+            conf = qrow[tok]
+            return tok[None], qrow, conf
+        probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)[0]
+        tok = jnp.argmax(probs).astype(jnp.int32)
+        return tok[None], probs, probs[tok]
+
+    def draft_model_candidates(seq, n, draft_cache, th, key):
+        """Draft up to k tokens, stopping early below the confidence th."""
         # catch-up: 2-token step over [t_{n-2}, t_{n-1}] heals the cache hole
         # left by a fully-accepted previous round (see module docstring)
         logits, draft_cache = _forward_at(
             draft_cfg, draft_params, draft_cache, seq, n - 2, 2, n - 2
         )
-        d1 = _greedy(logits[:, -1])
+        key, sub = jax.random.split(key)
+        d1, q1, conf1 = pick(logits[:, -1], sub)
 
-        def step(carry, _):
-            tok, dc = carry
-            pos = dc.length[None, None]  # [1,1]
-            lg, dc = decoder_forward(draft_cfg, draft_params, tok, dc, pos)
-            nxt = _greedy(lg[:, -1])[:, None]  # [1,1]
-            return (nxt, dc), tok[0]
+        drafts0 = jnp.full((k,), -1, jnp.int32).at[0].set(d1[0])
+        qbuf0 = jnp.zeros((k, vocab), jnp.float32).at[0].set(q1)
 
-        (last, draft_cache), drafted = jax.lax.scan(
-            step, (d1[:, None], draft_cache), None, length=k - 1
+        def dcond(c):
+            j, _, _, stop, _, _, _ = c
+            return (j < k) & ~stop
+
+        def dbody(c):
+            j, tok, dc, _, drafts, qbuf, key = c
+            pos = dc.length[None, None]
+            lg, dc = decoder_forward(draft_cfg, draft_params, tok[None], dc,
+                                     pos)
+            key, sub = jax.random.split(key)
+            nxt, qrow, conf = pick(lg[:, -1], sub)
+            drafts = drafts.at[j].set(nxt[0])
+            qbuf = jax.lax.dynamic_update_slice(qbuf, qrow[None], (j, 0))
+            stop = adaptive & (conf < th)
+            return (j + 1, nxt, dc, stop, drafts, qbuf, key)
+
+        j, _, draft_cache, _, drafts, qbuf, key = jax.lax.while_loop(
+            dcond, dbody,
+            (jnp.asarray(1, jnp.int32), d1, draft_cache,
+             adaptive & (conf1 < th), drafts0, qbuf0, key),
         )
-        # drafted: [k-1, 1] consumed tokens d1..d_{k-1}; add final d_k
-        drafts = jnp.concatenate([drafted[:, 0], last[0]])  # [k]
-        return drafts, draft_cache
+        return drafts, qbuf, j, draft_cache, key
 
-    def lookup_candidates(seq, n, draft_cache):
+    def lookup_candidates(seq, n, draft_cache, th, key):
         """Propose k tokens by matching the trailing n-gram in seq[0:n]."""
         ng = ngram
         tail = jax.lax.dynamic_slice(seq, (0, n - ng), (1, ng))[0]  # [ng]
@@ -130,16 +186,73 @@ def _spec_loop(
         cand = jax.lax.dynamic_slice(seq, (0, start), (1, k))[0]
         # no match: propose pad tokens (they will simply fail verification)
         drafts = jnp.where(any_match, cand, -jnp.ones((k,), jnp.int32))
-        return drafts, draft_cache
+        # lookup proposals carry no distribution: verification falls back to
+        # prefix-matching against per-position target samples (still exact)
+        qbuf = jnp.zeros((k, vocab), jnp.float32)
+        return drafts, qbuf, jnp.asarray(k, jnp.int32), draft_cache, key
 
-    candidates = lookup_candidates if draft_params is None else draft_model_candidates
+    lookup_mode = draft_params is None
+    candidates = lookup_candidates if lookup_mode else draft_model_candidates
+
+    def accept_greedy(drafts, qbuf, logits, k_drafted, key):
+        """Longest draft==argmax prefix, bonus from argmax."""
+        g = _greedy(logits)                          # [k+1]
+        match = (drafts == g[:k]) & (jnp.arange(k) < k_drafted)
+        n_acc = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((1,), bool)])
+        ).astype(jnp.int32)
+        acc = jnp.where(jnp.arange(k + 1) < n_acc, g[: k + 1], g[n_acc])
+        return acc, n_acc, key
+
+    def accept_sampling(drafts, qbuf, logits, k_drafted, key):
+        """Leviathan-style rejection sampling over the drafted run."""
+        p = dist(logits)                             # [k+1, V]
+        ar = jnp.arange(k)
+        live = (ar < k_drafted) & (drafts >= 0)
+        x = jnp.clip(drafts, 0, vocab - 1)
+        px = p[ar, x]
+        qx = qbuf[ar, x]
+        key, ku, kr = jax.random.split(key, 3)
+        u = jax.random.uniform(ku, (k,))
+        if lookup_mode:
+            # no q: sample the target chain and accept the matching prefix
+            t_chain = jax.random.categorical(
+                kr, jnp.log(p + 1e-30), axis=-1
+            ).astype(jnp.int32)                      # [k+1]
+            ok = (drafts == t_chain[:k]) & live
+            n_acc = jnp.argmin(
+                jnp.concatenate([ok, jnp.zeros((1,), bool)])
+            ).astype(jnp.int32)
+            corr = t_chain[n_acc]
+        else:
+            ok = (u * qx <= px) & live
+            n_acc = jnp.argmin(
+                jnp.concatenate([ok, jnp.zeros((1,), bool)])
+            ).astype(jnp.int32)
+            # correction token: residual max(p-q, 0) at the reject slot, or
+            # plain p_{k} when every draft survived
+            p_at = p[n_acc]                          # [V]
+            q_at = jnp.where(n_acc < k, qbuf[jnp.minimum(n_acc, k - 1)], 0.0)
+            res = jnp.maximum(p_at - q_at, 0.0)
+            res_sum = res.sum()
+            res = jnp.where(res_sum > 0, res / jnp.maximum(res_sum, 1e-20),
+                            p_at)
+            corr = jax.random.categorical(
+                kr, jnp.log(res + 1e-30)
+            ).astype(jnp.int32)
+        acc = jnp.where(jnp.arange(k + 1) < n_acc, jnp.append(x, 0), corr)
+        return acc, n_acc, key
+
+    accept = accept_sampling if sampling else accept_greedy
 
     def cond(st):
         return (st["n_new"] < max_new) & ~st["done"]
 
     def body(st):
         seq, n = st["seq"], st["n"]
-        drafts, dcache = candidates(seq, n, st["draft_cache"])
+        drafts, qbuf, k_drafted, dcache, key = candidates(
+            seq, n, st["draft_cache"], st["th"], st["key"]
+        )
 
         # verify: ONE target forward over [cur, d1..dk]
         verify_buf = jax.lax.dynamic_update_slice(
@@ -148,14 +261,8 @@ def _spec_loop(
         logits, tcache = _forward_at(
             cfg, params, st["cache"], verify_buf, n - 1, k + 1, n - 1
         )
-        g = _greedy(logits[0])                      # [k+1] target greedy
-        match = drafts == g[:k]                     # [k]
-        n_acc = jnp.argmin(
-            jnp.concatenate([match, jnp.zeros((1,), bool)])
-        ).astype(jnp.int32)                         # leading-match run length
+        acc, n_acc, key = accept(drafts, qbuf, logits[0], k_drafted, key)
 
-        # accepted tokens this round: d1..d_{n_acc} then bonus g[n_acc]
-        acc = jnp.where(jnp.arange(k + 1) < n_acc, g[: k + 1], g[n_acc])
         # stop at the first EOS inside the accepted run
         eos_hit = is_eos(acc) & (jnp.arange(k + 1) <= n_acc)
         any_eos = eos_hit.any()
@@ -169,14 +276,25 @@ def _spec_loop(
                            window_old)
         seq = jax.lax.dynamic_update_slice(seq, window, (0, n))
 
+        # th_stop_draft auto-tune (reference speculative.py:811-812): full
+        # acceptance => draft deeper next round (lower threshold); under
+        # half accepted => draft shallower (raise it)
+        frac = n_acc.astype(jnp.float32) / jnp.maximum(
+            k_drafted.astype(jnp.float32), 1.0
+        )
+        th = st["th"]
+        th = jnp.where(n_acc >= k_drafted, th * 0.9,
+                       jnp.where(frac < 0.5, th * 1.2, th))
+        th = jnp.clip(th, 0.02, 0.9)
+
         n2 = n + n_take
         tcache = replace(tcache, length=(n2 - 1).astype(jnp.int32))
         return {
             "seq": seq, "n": n2, "n_new": st["n_new"] + n_take,
-            "cache": tcache, "draft_cache": dcache,
+            "cache": tcache, "draft_cache": dcache, "key": key, "th": th,
             "done": st["done"] | any_eos,
             "rounds": st["rounds"] + 1,
-            "drafted": st["drafted"] + k,
+            "drafted": st["drafted"] + k_drafted,
             "matched": st["matched"] + n_acc,
         }
 
@@ -186,13 +304,16 @@ def _spec_loop(
         "n_new": jnp.asarray(1, jnp.int32),
         "cache": cache,
         "draft_cache": draft_cache,
+        "key": key,
+        "th": th0.astype(jnp.float32),
         "done": is_eos(seq_buf[0, n_prompt]),
         "rounds": jnp.asarray(0, jnp.int32),
         "drafted": jnp.asarray(0, jnp.int32),
         "matched": jnp.asarray(0, jnp.int32),
     }
     st = jax.lax.while_loop(cond, body, st)
-    return st["seq"], st["n_new"], st["rounds"], st["drafted"], st["matched"]
+    return (st["seq"], st["n_new"], st["rounds"], st["drafted"],
+            st["matched"], st["th"])
 
 
 def speculative_generate(
@@ -206,28 +327,38 @@ def speculative_generate(
     lookup: bool = False,
     ngram_size: int = 2,
     mesh=None,
+    th_stop_draft: float = 0.8,
+    auto_th_stop_draft: bool = True,
+    seed: int | None = None,
 ) -> GenerateResult:
-    """Speculative (or prompt-lookup when ``lookup=True``) greedy decoding.
+    """Speculative (or prompt-lookup when ``lookup=True``) decoding.
 
     ``draft_params`` defaults to the target params (still profitable when the
     verify forward amortizes weight reads over k+1 tokens).  Batch size 1,
-    greedy only — matching the reference's supported envelope
-    (speculative.py:811 asserts bs==1).
+    matching the reference's supported envelope (speculative.py:811 asserts
+    bs==1).  Greedy verification reproduces plain decoding token-for-token;
+    ``do_sample=True`` runs rejection-sampling verification whose output
+    distribution equals plain target sampling.  ``th_stop_draft`` /
+    ``auto_th_stop_draft`` mirror the reference kwargs (speculative.py:811).
     """
     gen = generation_config
-    if gen.do_sample:
-        raise NotImplementedError("speculative decoding is greedy-only")
+    if gen.do_sample and gen.repetition_penalty != 1.0:
+        raise NotImplementedError(
+            "sampled speculative decoding does not support repetition_penalty"
+        )
     from ipex_llm_tpu.ops import dispatch as _dispatch
 
     with _dispatch.spmd(mesh if mesh is not None and mesh.size > 1 else None):
         return _speculative_inner(
             cfg, params, input_ids, gen, draft_params, draft_cfg,
-            max_step_draft, lookup, ngram_size, mesh,
+            max_step_draft, lookup, ngram_size, mesh, th_stop_draft,
+            auto_th_stop_draft, seed,
         )
 
 
 def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
-                       max_step_draft, lookup, ngram_size, mesh):
+                       max_step_draft, lookup, ngram_size, mesh,
+                       th_stop_draft, auto_th_stop_draft, seed=None):
     tokens, lengths, tpad = pad_batch(input_ids, gen.pad_token_id, bucket=1)
     if tokens.shape[0] != 1:
         raise ValueError("speculative decoding supports batch size 1")
@@ -244,7 +375,8 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
     same_weights = draft_params is params
     s_max = _round_up(n_p + gen.max_new_tokens + k + 2, DECODE_BLOCK)
     cache = kv_mod.make_cache(
-        "normal", cfg.num_layers, 1, s_max, cfg.num_kv_heads, cfg.head_dim
+        "normal", cfg.num_layers, 1, s_max, cfg.num_kv_heads, cfg.head_dim,
+        v_head_dim=cfg.v_dim,
     )
     if lookup:
         # unused by the lookup path; a 1-slot dummy avoids donating the
@@ -253,7 +385,7 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
     elif not same_weights:
         draft_cache = kv_mod.make_cache(
             "normal", draft_cfg.num_layers, 1, s_max, draft_cfg.num_kv_heads,
-            draft_cfg.head_dim,
+            draft_cfg.head_dim, v_head_dim=draft_cfg.v_dim,
         )
     if mesh is not None:
         from ipex_llm_tpu.parallel import shard as shard_mod
@@ -285,17 +417,31 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
             draft_cfg, draft_params, seq_buf[:, :n_p], draft_cache, pos,
             last_token_only=True,
         )
-    first = _greedy(logits)
+    # greedy verification ignores sampling params — keep them out of
+    # the jit static key so temperature changes don't recompile
+    sp = gen.sampling() if gen.do_sample else None
+    # ``seed`` overrides gen.seed WITHOUT entering the jit static args, so
+    # sweeping seeds (e.g. the distribution test) reuses one compilation
+    key = jax.random.PRNGKey(gen.seed if seed is None else seed)
+    key, kfirst = jax.random.split(key)
+    if gen.do_sample:
+        from ipex_llm_tpu.ops.sampling import sample
+
+        first = sample(logits, kfirst, sp)
+    else:
+        first = _greedy(logits)
     seq_buf = jax.lax.dynamic_update_slice(seq_buf, first[None], (0, n_p))
     jax.block_until_ready(first)
     ttft = time.perf_counter() - t0
 
     t1 = time.perf_counter()
-    seq_buf, n_new, rounds, drafted, matched = _spec_loop(
+    seq_buf, n_new, rounds, drafted, matched, th_final = _spec_loop(
         cfg, draft_cfg, params,
         None if lookup else draft_params,
         cache, draft_cache, seq_buf, jnp.asarray(n_p, jnp.int32),
+        key, jnp.asarray(th_stop_draft, jnp.float32),
         k, gen.max_new_tokens, gen.eos_token_id, ngram=ngram_size,
+        sp=sp, adaptive=auto_th_stop_draft,
     )
     seq = np.asarray(seq_buf)
     n_new = int(n_new)
@@ -312,4 +458,5 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
     res.n_rounds = int(rounds)
     res.n_drafted = int(drafted)
     res.n_matched = int(matched)
+    res.th_stop_draft = float(th_final)
     return res

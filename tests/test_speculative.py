@@ -127,3 +127,96 @@ def test_model_api_speculative(tmp_path):
     np.testing.assert_array_equal(got[0], want[0])
     lk = model.lookup_generate(prompt, max_new_tokens=8)
     np.testing.assert_array_equal(lk[0], want[0])
+
+
+# ---------------------------------------------------------------------------
+# rejection-sampling verification + adaptive drafting (reference
+# speculative.py:805-1100 sampled path, :811-812 th_stop_draft auto-tune)
+# ---------------------------------------------------------------------------
+
+
+def _marginal(counts_from, cfg, n_runs):
+    freq = {}
+    for s in counts_from:
+        freq[s] = freq.get(s, 0) + 1.0 / n_runs
+    return freq
+
+
+def _tv(f1, f2):
+    keys = set(f1) | set(f2)
+    return 0.5 * sum(abs(f1.get(t, 0.0) - f2.get(t, 0.0)) for t in keys)
+
+
+def test_sampled_speculative_distribution(cfg_params):
+    """The marginal distribution of spec-sampled output must match plain
+    target sampling even with a deliberately WRONG draft model — the
+    rejection test corrects any proposal.  (A broken verifier that keeps
+    draft tokens would pull the marginal toward the draft's argmax.)"""
+    cfg, params = cfg_params
+    draft_params = rand_params(cfg, qtype="sym_int4")  # different weights
+    prompt = list(RNG.integers(0, cfg.vocab_size, 12))
+    n_runs = 120
+    gen = GenerationConfig(max_new_tokens=3, do_sample=True,
+                           temperature=0.6, top_k=8)
+
+    # speculative: one compiled program, seeds swept as traced keys
+    spec_tok2 = []
+    for seed in range(n_runs):
+        got = speculative_generate(
+            cfg, params, [prompt], gen, draft_params=draft_params,
+            max_step_draft=3, auto_th_stop_draft=False, seed=seed,
+        )
+        spec_tok2.append(int(got.sequences[0, len(prompt) + 1]))
+
+    # plain target sampling: one batched call, rows are independent draws
+    want = generate(cfg, params, [prompt] * n_runs, gen)
+    plain_tok2 = [int(want.sequences[i, len(prompt) + 1])
+                  for i in range(n_runs)]
+
+    f_spec = _marginal(spec_tok2, cfg, n_runs)
+    f_plain = _marginal(plain_tok2, cfg, n_runs)
+    assert _tv(f_spec, f_plain) < 0.25, (f_spec, f_plain)
+
+
+def test_sampled_lookup_runs(cfg_params):
+    """Prompt-lookup with sampling: prefix-match verification stays in the
+    target distribution and terminates."""
+    cfg, params = cfg_params
+    pat = [5, 6, 7, 8, 9, 10]
+    prompt = pat * 4
+    gen = GenerationConfig(max_new_tokens=12, do_sample=True,
+                           temperature=0.8, seed=11)
+    got = speculative_generate(cfg, params, [prompt], gen, lookup=True,
+                               max_step_draft=4)
+    assert int(got.num_new_tokens[0]) == 12
+
+
+def test_adaptive_th_stop_draft(cfg_params):
+    """auto_th_stop_draft must (a) stop drafting early on low-confidence
+    rounds (n_drafted < rounds*k) and (b) move the threshold."""
+    cfg, params = cfg_params
+    # a wrong draft at high temperature: confidence is low, acceptance poor
+    draft_params = rand_params(cfg, qtype="sym_int4")
+    prompt = list(RNG.integers(0, cfg.vocab_size, 16))
+    gen = GenerationConfig(max_new_tokens=24, do_sample=False)
+    k = 6
+    got = speculative_generate(
+        cfg, params, [prompt], gen, draft_params=draft_params,
+        max_step_draft=k, th_stop_draft=0.8, auto_th_stop_draft=True,
+    )
+    fixed = speculative_generate(
+        cfg, params, [prompt], gen, draft_params=draft_params,
+        max_step_draft=k, auto_th_stop_draft=False,
+    )
+    # fixed mode always drafts exactly k per round
+    assert fixed.n_drafted == fixed.n_rounds * k
+    # adaptive mode stopped early at least once on this weak draft
+    assert got.n_drafted < got.n_rounds * k
+    # and the threshold auto-tuned away from its start
+    assert got.th_stop_draft != 0.8
+    # output identity still holds under greedy verification
+    n = min(int(got.num_new_tokens[0]), int(fixed.num_new_tokens[0]))
+    np.testing.assert_array_equal(
+        got.sequences[0, : len(prompt) + n],
+        fixed.sequences[0, : len(prompt) + n],
+    )
