@@ -125,6 +125,104 @@ class Fp8KVCache(KVCache):
         return kl.astype(compute_dtype)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class PagedKVCache:
+    """Block-table KV over a shared page pool (the vLLM paged-KV peer).
+
+    The reference delegates this axis to vLLM's PagedAttention (SURVEY §2.1
+    vllm/, 4,488 LoC); the TPU-native form keeps every shape static:
+
+    - ONE pool per k/v of shape ``[L, P, Hkv, page, D]`` shared by all rows,
+    - a per-row block table ``[R, maxP]`` of page ids (-1 = unallocated),
+    - writes scatter into ``(table[r, slot//page], slot % page)``,
+    - reads gather the row's pages back into the head-major ``[R, H, S, D]``
+      view the decode kernel consumes; invalid tail pages are masked by
+      ``kv_len`` exactly like dense-cache slack.
+
+    Page allocation, refcounts, and prefix sharing are host-side concerns
+    (serving/engine.py PageAllocator) — the device object is pure data.
+    """
+
+    k: jnp.ndarray       # [L, P, Hkv, page, D]
+    v: jnp.ndarray       # [L, P, Hkv, page, Dv]
+    tables: jnp.ndarray  # [R, maxP] int32 page ids, -1 = unallocated
+    length: jnp.ndarray  # scalar int32 (engines drive per-row slot_offsets)
+
+    storage: str = "bf16"
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.tables, self.length), (self.storage,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k, v, tables, length = children
+        return cls(k, v, tables, length, storage=aux[0])
+
+    @classmethod
+    def init(cls, n_layers: int, n_pages: int, n_rows: int, max_pages: int,
+             n_kv_heads: int, page_size: int, head_dim: int,
+             dtype=jnp.bfloat16, v_head_dim: int | None = None):
+        vd = v_head_dim if v_head_dim is not None else head_dim
+        return cls(
+            k=jnp.zeros((n_layers, n_pages, n_kv_heads, page_size, head_dim),
+                        dtype),
+            v=jnp.zeros((n_layers, n_pages, n_kv_heads, page_size, vd), dtype),
+            tables=jnp.full((n_rows, max_pages), -1, jnp.int32),
+            length=jnp.zeros((), jnp.int32),
+            storage="bf16",
+        )
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_len(self) -> int:
+        return self.tables.shape[1] * self.page_size
+
+    def encode(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(self.k.dtype)
+
+    def decode_layer(self, kl: jnp.ndarray, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
+        return kl.astype(compute_dtype)
+
+    def update_layer(self, kl: jnp.ndarray, vl: jnp.ndarray,
+                     new_k: jnp.ndarray, new_v: jnp.ndarray, pos: jnp.ndarray):
+        """Scatter new_k/new_v [B, T, H, D] into pool layer [P, H, page, D]
+        through the block table at per-row slot offsets ``pos`` [B]."""
+        ps = self.page_size
+        b, t = new_k.shape[:2]
+        maxp = self.tables.shape[1]
+        if getattr(pos, "ndim", 0) == 0:
+            pos = jnp.broadcast_to(pos, (b,))
+        slots = pos[:, None] + jnp.arange(t)[None, :]           # [B, T]
+        page_idx = slots // ps
+        pages = self.tables[jnp.arange(b)[:, None],
+                            jnp.clip(page_idx, 0, maxp - 1)]
+        # page 0 is the engine's scratch page (never allocated to a row):
+        # writes past the table width (right-padded prefill tail) or into
+        # unallocated slots land there instead of corrupting live pages
+        valid = (page_idx < maxp) & (pages >= 0)
+        pages = jnp.where(valid, pages, 0)
+        offs = slots % ps
+        kl = kl.at[pages, :, offs].set(self.encode(new_k))
+        vl = vl.at[pages, :, offs].set(self.encode(new_v))
+        return kl, vl
+
+    def gather_layer(self, kl: jnp.ndarray) -> jnp.ndarray:
+        """Pool layer [P, H, page, D] -> head-major rows [R, H, maxP*page, D]
+        (the raw layout cached_sdpa's decode path consumes)."""
+        r, maxp = self.tables.shape
+        t = jnp.clip(self.tables, 0, kl.shape[0] - 1)
+        g = kl[t]                                   # [R, maxP, H, page, D]
+        g = g.transpose(0, 2, 1, 3, 4)
+        return g.reshape(r, g.shape[1], maxp * self.page_size, g.shape[4])
+
+    def advanced(self, n):
+        return replace(self, length=self.length + n)
+
+
 def make_cache(kind: str, *args: Any, **kwargs: Any) -> KVCache:
     """kind: 'normal' | 'fp8' (compress/SnapKV variant: see ipex_llm_tpu.compresskv)."""
     if kind == "normal":

@@ -163,6 +163,13 @@ def cached_sdpa(
     """
     from ipex_llm_tpu.ops import dispatch
 
+    if hasattr(cache, "tables"):
+        # paged pool layer (serving engine): gather the rows' pages into the
+        # head-major [B, Hkv, S, D] view; tail pages beyond kv_len are
+        # garbage and masked exactly like dense-cache slack
+        kl = cache.gather_layer(kl)
+        vl = cache.gather_layer(vl)
+
     t = q.shape[1]
     decode_ok = (
         t == 1

@@ -1,19 +1,23 @@
-"""Continuous-batching decode engine (the vLLM-equivalent core).
+"""Continuous-batching decode engine over a paged KV pool (vLLM-core peer).
 
 Design (TPU-first; contrast reference vllm/ + PPModelWorker
 pipeline_parallel.py:482-928 which rely on vLLM's paged attention):
 
-- a fixed pool of ``max_rows`` sequence rows sharing one static KV buffer
-  ``[L, R, H, S_max, D]`` — static shapes mean the decode step compiles
-  exactly once;
-- every step decodes ALL rows in one jitted call; inactive rows are masked
-  (their sampled token is ignored), so join/leave never recompiles;
-- a new request prefills on the bucketed single-row program (reusing
-  generation.prefill_step) and its KV slice is copied into a free row
-  between steps — prefill never blocks other rows' decode for more than one
-  step boundary;
-- per-row temperature/top-p live as traced vectors, so heterogeneous
-  sampling params ride the same program.
+- **paged KV**: one static pool ``[L, P, H, page, D]`` shared by every row,
+  per-row block tables (kv.PagedKVCache) — HBM scales with TOKENS IN USE,
+  not rows x S_max, so concurrency is bounded by real load, and the decode
+  step still compiles exactly once (all shapes static);
+- **prefix caching**: full pages of a prompt are content-hashed (a chained
+  hash, so a page's identity covers everything before it); a new request
+  reuses matching pages from earlier requests with refcounts and prefills
+  only the remainder — the vLLM prefix-cache equivalent;
+- **chunked prefill**: admission runs the prompt through fixed-size chunks,
+  at most ONE chunk between decode steps, so a 2k-token prefill never stalls
+  in-flight streams by more than one chunk forward (reference gap: r2's
+  engine ran whole prefills synchronously on the engine thread);
+- every step decodes ALL rows in one jitted call; inactive rows are masked,
+  so join/leave never recompiles; per-row temperature/top-p ride as traced
+  vectors.
 
 The engine thread owns the device; asyncio handlers talk to it through
 queues (reference fastapi server uses the same queue pattern,
@@ -22,19 +26,20 @@ api_server.py).
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import threading
 import time
-from dataclasses import dataclass, field
+from collections import OrderedDict
+from dataclasses import dataclass, field, replace
 from functools import partial
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ipex_llm_tpu.generation import _round_up, prefill_step
-from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.kv import PagedKVCache
 from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
 
@@ -43,9 +48,26 @@ NEG_INF = -1e30
 
 @dataclass(frozen=True)
 class EngineConfig:
-    max_rows: int = 4           # concurrent sequences
-    max_seq_len: int = 2048     # per-row KV capacity
-    prefill_bucket: int = 128
+    max_rows: int = 16          # concurrent sequences
+    max_seq_len: int = 4096     # per-row KV capacity (block-table width)
+    page_size: int = 128        # KV page length (slots)
+    pool_pages: int = 0         # 0 = auto: max_rows * max_seq_len / page / 2
+    prefill_bucket: int = 128   # chunked-prefill chunk length
+
+    @property
+    def n_pages(self) -> int:
+        if self.pool_pages:
+            return self.pool_pages
+        # 2x oversubscription: the paged pool holds half the worst case,
+        # which real mixed-length traffic rarely approaches (the point of
+        # paging); raise pool_pages for pathological all-max-len loads.
+        # +2: page 0 is the reserved scratch page
+        return max(self.max_rows * self.max_seq_len // self.page_size // 2,
+                   self.max_rows + 2)
+
+    @property
+    def max_pages(self) -> int:
+        return self.max_seq_len // self.page_size
 
 
 @dataclass
@@ -69,7 +91,76 @@ class Request:
         self.cancelled = True
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+class PageAllocator:
+    """Host-side page pool bookkeeping: free list, refcounts, and the
+    chained-hash prefix cache (LRU-evicted when the pool runs dry)."""
+
+    def __init__(self, n_pages: int):
+        # page 0 is the device scratch page (kv.PagedKVCache.update_layer
+        # routes out-of-range/pad writes there) — never handed out
+        self.n_pages = n_pages
+        self.free: list[int] = list(range(n_pages - 1, 0, -1))
+        self.ref = np.zeros((n_pages,), np.int32)
+        # prefix cache: chain-hash -> page id; insertion order ~ LRU
+        self.prefix: "OrderedDict[bytes, int]" = OrderedDict()
+        self._page_key: dict[int, bytes] = {}
+
+    def alloc(self) -> int | None:
+        if not self.free and not self._evict_one():
+            return None
+        pid = self.free.pop()
+        self.ref[pid] = 1
+        return pid
+
+    def addref(self, pid: int):
+        self.ref[pid] += 1
+
+    def decref(self, pid: int):
+        self.ref[pid] -= 1
+        if self.ref[pid] == 0:
+            self.free.append(pid)
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used prefix page held only by the cache."""
+        for key, pid in self.prefix.items():
+            if self.ref[pid] == 1:  # only the cache references it
+                del self.prefix[key]
+                del self._page_key[pid]
+                self.decref(pid)
+                return True
+        return False
+
+    def register_prefix(self, key: bytes, pid: int):
+        if key in self.prefix or pid in self._page_key:
+            return
+        self.prefix[key] = pid
+        self._page_key[pid] = key
+        self.addref(pid)  # the cache's own reference
+
+    def lookup_prefix(self, key: bytes) -> int | None:
+        pid = self.prefix.get(key)
+        if pid is not None:
+            self.prefix.move_to_end(key)  # LRU touch
+        return pid
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.n_pages - 1 - len(self.free)
+
+
+def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
+    """Chained content hash per full page: page i's key commits to every
+    token before it, so equal keys imply equal K/V contents."""
+    keys, h = [], b""
+    for i in range(len(prompt) // page_size):
+        h = hashlib.sha256(
+            h + prompt[i * page_size : (i + 1) * page_size].tobytes()
+        ).digest()
+        keys.append(h)
+    return keys
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
                  temps, top_ps, key):
     """One batched decode step over the whole row pool.
@@ -89,29 +180,27 @@ def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
     return nxt, cache, key
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _insert_row(cache: KVCache, prefill_cache: KVCache, n_valid, row):
-    """Copy a prefilled single-row cache (left-padded) into pool row ``row``
-    at slot 0."""
-    # valid slots of the prefill cache are [tpad - n, tpad); shift to 0
-    tpad = prefill_cache.k.shape[3]
-    start = tpad - n_valid
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _prefill_chunk(cfg: ModelConfig, params, cache, tokens, table_row,
+                   base_len, n_valid):
+    """Run one right-padded prompt chunk for a single row.
 
-    def per_layer_copy(pool_buf, pre_buf):
-        # pool_buf [L,R,H,S,D]; pre_buf [L,1,H,Tpad,D]
-        src = jnp.roll(pre_buf[:, 0], -start, axis=2)       # valid now at 0
-        src = src[:, :, : pool_buf.shape[3]]                # clip to S_max
-        pad = pool_buf.shape[3] - src.shape[2]
-        if pad > 0:
-            src = jnp.pad(src, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        return pool_buf.at[:, row].set(src.astype(pool_buf.dtype))
-
-    return KVCache(
-        k=per_layer_copy(cache.k, prefill_cache.k),
-        v=per_layer_copy(cache.v, prefill_cache.v),
-        length=cache.length,
-        storage=cache.storage,
+    tokens [1, C]; table_row [1, maxP] (that row's block table); base_len
+    scalar: slots already filled.  Pad positions write garbage K/V into the
+    row's own future slots — subsequent chunks/decode steps overwrite them
+    in order, and causal masking keeps valid queries from seeing them.
+    Returns (last-valid-position logits [1, V], updated cache).
+    """
+    row_cache = replace(cache, tables=table_row)
+    pos = base_len + jnp.arange(tokens.shape[1])[None, :]
+    logits, row_cache = decoder_forward(
+        cfg, params, tokens, row_cache, pos,
+        slot_offsets=jnp.reshape(base_len, (1,)),
     )
+    last = jnp.take_along_axis(
+        logits, (n_valid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1
+    )[:, 0]
+    return last, replace(row_cache, tables=cache.tables)
 
 
 class ServingEngine:
@@ -124,20 +213,29 @@ class ServingEngine:
         self.params = params
         self.ec = engine_config or EngineConfig()
         self.default_eos = default_eos
-        r, s = self.ec.max_rows, self.ec.max_seq_len
-        self.cache = KVCache.init(cfg.num_layers, r, s, cfg.num_kv_heads,
-                                  cfg.head_dim)
+        r = self.ec.max_rows
+        self.cache = PagedKVCache.init(
+            cfg.num_layers, self.ec.n_pages, r, self.ec.max_pages,
+            cfg.num_kv_heads, self.ec.page_size, cfg.head_dim,
+            v_head_dim=cfg.v_dim,
+        )
+        self.alloc = PageAllocator(self.ec.n_pages)
+        self.tables = np.full((r, self.ec.max_pages), -1, np.int32)
         self.rows: list[Request | None] = [None] * r
         self.row_lens = np.zeros((r,), np.int32)
         self.row_budget = np.zeros((r,), np.int32)
         self.toks = np.zeros((r,), np.int32)
         self.temps = np.zeros((r,), np.float32)
         self.top_ps = np.ones((r,), np.float32)
+        # chunked prefill: rows still consuming their prompt
+        self._prefilling: dict[int, np.ndarray] = {}  # row -> remaining ids
+        self._row_keys: dict[int, list[bytes]] = {}   # row -> prefix hashes
         self.key = jax.random.PRNGKey(0)
         self._inbox: "queue.Queue[Request]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.metrics = {"requests": 0, "tokens": 0, "steps": 0}
+        self.metrics = {"requests": 0, "tokens": 0, "steps": 0,
+                        "prefix_hits": 0, "prefix_pages_shared": 0}
 
     # -- public API ---------------------------------------------------------
 
@@ -157,6 +255,36 @@ class ServingEngine:
         self._inbox.put(req)
         return req
 
+    def abort(self, req: Request):
+        """Cancel a request (e.g. client disconnect); its row frees at the
+        next step boundary."""
+        req.cancelled = True
+
+    # -- page bookkeeping ----------------------------------------------------
+
+    def _ensure_pages(self, row: int, upto_slot: int) -> bool:
+        """Allocate pages so slots [0, upto_slot) are backed; False = dry.
+
+        ``upto_slot`` past the table width is tolerated: the overflow is
+        only ever right-padded prefill slack, which update_layer routes to
+        the scratch page (admission caps real tokens at capacity).
+        """
+        need = min(-(-upto_slot // self.ec.page_size), self.ec.max_pages)
+        for j in range(need):
+            if self.tables[row, j] < 0:
+                pid = self.alloc.alloc()
+                if pid is None:
+                    return False
+                self.tables[row, j] = pid
+        return True
+
+    def _release_row_pages(self, row: int):
+        for j in range(self.ec.max_pages):
+            pid = int(self.tables[row, j])
+            if pid >= 0:
+                self.alloc.decref(pid)
+                self.tables[row, j] = -1
+
     # -- engine loop --------------------------------------------------------
 
     def _free_row(self) -> int | None:
@@ -165,20 +293,10 @@ class ServingEngine:
                 return i
         return None
 
-    def abort(self, req: Request):
-        """Cancel a request (e.g. client disconnect); its row frees at the
-        next step boundary."""
-        req.cancelled = True
-
-    def _admit(self, max_joins: int = 1):
-        """Join pending requests into free rows (between decode steps).
-
-        At most ``max_joins`` per step boundary while other rows decode, so
-        a burst of prefills can't stall in-flight streams for more than one
-        prefill forward per emitted token.
-        """
-        joined = 0
-        while joined < max_joins:
+    def _admit(self):
+        """Join pending requests into free rows (host-side work only —
+        prefix matching + page allocation; prefill happens chunk-wise)."""
+        while True:
             row = self._free_row()
             if row is None:
                 return
@@ -190,44 +308,109 @@ class ServingEngine:
                 req.finish_reason = "abort"
                 req.stream_queue.put(None)
                 continue
-            joined += 1
             prompt = np.asarray(req.prompt_ids, np.int32)
             n_p = len(prompt)
-            if n_p + req.max_new_tokens > self.ec.max_seq_len:
+            ps = self.ec.page_size
+            # addressable capacity: the block-table width floors
+            # max_seq_len/page_size, and a request can never hold more
+            # pages than the pool owns (page 0 is reserved scratch)
+            capacity = min(self.ec.max_seq_len, self.ec.max_pages * ps,
+                           (self.ec.n_pages - 1) * ps)
+            if n_p + req.max_new_tokens > capacity or n_p == 0:
                 req.finish_reason = "length"
                 req.stream_queue.put(None)
                 continue
-            tpad = _round_up(max(n_p, 1), self.ec.prefill_bucket)
-            toks = np.zeros((1, tpad), np.int32)
-            toks[0, tpad - n_p:] = prompt
-            pre_cache = KVCache.init(
-                self.cfg.num_layers, 1, tpad, self.cfg.num_kv_heads,
-                self.cfg.head_dim,
-            )
-            logits, pre_cache = prefill_step(
-                self.cfg, self.params, pre_cache, jnp.asarray(toks),
-                jnp.asarray([n_p], np.int32),
-            )
-            self.cache = _insert_row(
-                self.cache, pre_cache, jnp.asarray(n_p, jnp.int32),
-                jnp.asarray(row, jnp.int32),
-            )
-            from ipex_llm_tpu.ops.sampling import sample_rows
 
-            self.key, sub = jax.random.split(self.key)
-            first = int(np.asarray(sample_rows(
-                logits, jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_p], jnp.float32), sub,
-            ))[0])
-            req.first_token_s = time.perf_counter() - req.submitted_s
+            # prefix cache: reuse the longest chain of full pages covering
+            # at most the first n_p - 1 tokens (at least one token must run
+            # through the model to produce logits)
+            keys = _chain_hashes(prompt, ps)
+            shareable = min(len(keys), (n_p - 1) // ps)
+            shared = 0
+            for i in range(shareable):
+                pid = self.alloc.lookup_prefix(keys[i])
+                if pid is None:
+                    break
+                self.alloc.addref(pid)
+                self.tables[row, i] = pid
+                shared += 1
+            if shared:
+                self.metrics["prefix_hits"] += 1
+                self.metrics["prefix_pages_shared"] += shared
+
+            base = shared * ps
+            if not self._ensure_pages(row, n_p):
+                # pool dry even after eviction: release everything this row
+                # touched (shared refs AND partial fresh allocations)
+                self._release_row_pages(row)
+                if any(r is not None for r in self.rows) or self._prefilling:
+                    self._inbox.put(req)  # retry once in-flight rows free pages
+                else:
+                    # nothing running, nothing evictable: it will never fit
+                    req.finish_reason = "length"
+                    req.stream_queue.put(None)
+                return
+
             self.rows[row] = req
-            self.row_lens[row] = n_p
+            self.row_lens[row] = base
             self.row_budget[row] = req.max_new_tokens
-            self.toks[row] = first
             self.temps[row] = req.temperature
             self.top_ps[row] = req.top_p
+            self._prefilling[row] = prompt[base:]
+            self._row_keys[row] = keys
             self.metrics["requests"] += 1
-            self._emit(row, first)
+
+    def _prefill_one_chunk(self):
+        """Advance ONE prefilling row by one chunk (bounded stall)."""
+        if not self._prefilling:
+            return
+        row = next(iter(self._prefilling))
+        req = self.rows[row]
+        if req is None or req.cancelled:
+            self._prefilling.pop(row, None)
+            if req is not None:
+                self._finish(row, "abort")
+            return
+        remaining = self._prefilling[row]
+        cp = self.ec.prefill_bucket
+        chunk = remaining[:cp]
+        n_valid = len(chunk)
+        base = int(self.row_lens[row])
+        # pages are needed only for real tokens; the right-pad tail
+        # lands on the scratch page via update_layer's valid mask
+        if not self._ensure_pages(row, base + n_valid):
+            self._finish(row, "error")  # pool exhausted mid-prefill
+            self._prefilling.pop(row, None)
+            return
+        toks = np.zeros((1, cp), np.int32)
+        toks[0, :n_valid] = chunk
+        cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        logits, self.cache = _prefill_chunk(
+            self.cfg, self.params, cache, jnp.asarray(toks),
+            jnp.asarray(self.tables[row : row + 1]),
+            jnp.asarray(base, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+        )
+        self.row_lens[row] = base + n_valid
+        if n_valid < len(remaining):
+            self._prefilling[row] = remaining[n_valid:]
+            return
+        # prompt complete: register new full pages in the prefix cache,
+        # sample the first token, enter decode
+        self._prefilling.pop(row, None)
+        n_p = int(self.row_lens[row])
+        keys = self._row_keys.pop(row, [])
+        for i in range(min(len(keys), (n_p - 1) // self.ec.page_size)):
+            self.alloc.register_prefix(keys[i], int(self.tables[row, i]))
+        from ipex_llm_tpu.ops.sampling import sample_rows
+
+        self.key, sub = jax.random.split(self.key)
+        first = int(np.asarray(sample_rows(
+            logits, jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32), sub,
+        ))[0])
+        req.first_token_s = time.perf_counter() - req.submitted_s
+        self.toks[row] = first
+        self._emit(row, first)
 
     def _emit(self, row: int, token: int):
         req = self.rows[row]
@@ -253,6 +436,9 @@ class ServingEngine:
         self.rows[row] = None
         self.row_lens[row] = 0
         self.toks[row] = 0
+        self._prefilling.pop(row, None)
+        self._row_keys.pop(row, None)
+        self._release_row_pages(row)
 
     def _fail_all(self, exc: BaseException):
         """Engine-level failure: finish every in-flight/queued request so no
@@ -279,27 +465,40 @@ class ServingEngine:
 
     def _step_once(self):
         self._admit()
+        self._prefill_one_chunk()
         for i, req in enumerate(self.rows):  # drop disconnected clients
             if req is not None and req.cancelled:
                 self._finish(i, "abort")
-        active = np.array([r is not None for r in self.rows])
+        active = np.array([
+            r is not None and i not in self._prefilling
+            for i, r in enumerate(self.rows)
+        ])
         if not active.any():
+            if self._prefilling:
+                return  # keep chunking
             try:
                 req = self._inbox.get(timeout=0.02)
                 self._inbox.put(req)
             except queue.Empty:
                 pass
             return
-        # KV write for the current token happens inside the step; the
-        # token at row_lens gets slot row_lens
+        # allocate the page for this step's KV write (slot row_lens)
+        for i in range(len(self.rows)):
+            if active[i] and not self._ensure_pages(i, int(self.row_lens[i]) + 1):
+                self._finish(i, "length")
+                active[i] = False
+        if not active.any():
+            return
+        cache = replace(self.cache, tables=jnp.asarray(self.tables))
         nxt, self.cache, self.key = _decode_step(
-            self.cfg, self.params, self.cache,
+            self.cfg, self.params, cache,
             jnp.asarray(self.toks), jnp.asarray(self.row_lens),
             jnp.asarray(active), jnp.asarray(self.temps),
             jnp.asarray(self.top_ps), self.key,
         )
         nxt = np.asarray(nxt)
         self.metrics["steps"] += 1
+        self.metrics["pages_in_use"] = self.alloc.pages_in_use
         for i in range(len(self.rows)):
             if not active[i] or self.rows[i] is None:
                 continue
