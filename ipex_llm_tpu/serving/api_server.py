@@ -192,6 +192,7 @@ class OpenAIServer:
         })
 
     async def _chat_json(self, body: dict, ids: list[int]):
+        rf = body.get("response_format") or {}
         import asyncio as _asyncio
 
         from ipex_llm_tpu.structured import generate_json
@@ -202,6 +203,7 @@ class OpenAIServer:
             lambda: generate_json(
                 self.engine.cfg, self.engine.params, self.tok, ids,
                 max_new_tokens=int(body.get("max_tokens") or 256),
+                schema=(rf.get("json_schema") or {}).get("schema"),
             ),
         )
         return web.json_response({
@@ -294,8 +296,8 @@ def main(argv=None):
     ap.add_argument("--low-bit", default="sym_int4")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
-    ap.add_argument("--max-rows", type=int, default=4)
-    ap.add_argument("--max-seq-len", type=int, default=2048)
+    ap.add_argument("--max-rows", type=int, default=16)
+    ap.add_argument("--max-seq-len", type=int, default=4096)
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,

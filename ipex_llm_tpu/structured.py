@@ -1,4 +1,4 @@
-"""Structured output: JSON-constrained decoding.
+"""Structured output: JSON- and JSON-schema-constrained decoding.
 
 Reference counterpart: the xgrammar logits-processor shim (reference
 xgrammar.py:21-47) which delegates grammar compilation to the external
@@ -6,12 +6,18 @@ xgrammar.py:21-47) which delegates grammar compilation to the external
 is a self-contained implementation: an incremental JSON pushdown validator
 plus top-k filtered decoding — each step takes the highest-logit token whose
 text keeps the output a valid JSON prefix, guaranteeing the final text
-parses.  (Schema enforcement beyond well-formed JSON objects is future
-work; the reference's shim is similarly scoped to what xgrammar compiles.)
+parses.  A compiled JSON-schema subset (``compile_schema``) rides the same
+pushdown: type gating per value, ``properties``/``required``/
+``additionalProperties`` on objects, ``items`` on arrays, and
+``enum``/``const`` enforced character-by-character (string members restrict
+every char to a member prefix).  Unsupported keywords ($ref, anyOf, pattern,
+min/max bounds) are ignored — constraints never loosen below well-formed
+JSON.
 """
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -21,6 +27,88 @@ import numpy as np
 
 _WS = " \t\n\r"
 _DIGITS = "0123456789"
+
+_ALL_TYPES = frozenset(
+    ("object", "array", "string", "number", "integer", "boolean", "null")
+)
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Compiled JSON-schema subset (hashable, shared between clones)."""
+
+    types: frozenset = _ALL_TYPES
+    properties: tuple = ()           # ((name, Schema), ...)
+    required: frozenset = frozenset()
+    additional: bool = True          # additionalProperties
+    items: "Schema | None" = None
+    enum: tuple = ()                 # python values; () = unconstrained
+
+    def prop(self, name: str) -> "Schema | None":
+        for k, s in self.properties:
+            if k == name:
+                return s
+        return None
+
+    def prop_names(self) -> list[str]:
+        return [k for k, _ in self.properties]
+
+    def enum_strings(self) -> list[str]:
+        return [v for v in self.enum if isinstance(v, str)]
+
+    def enum_numbers(self) -> list[float]:
+        return [float(v) for v in self.enum
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+
+
+ANY_SCHEMA = Schema()
+
+
+def compile_schema(d: dict | None) -> Schema:
+    """Compile a JSON-schema dict into the enforced subset."""
+    if not d:
+        return ANY_SCHEMA
+    t = d.get("type")
+    if isinstance(t, str):
+        types = frozenset((t,))
+    elif isinstance(t, list):
+        types = frozenset(t) & _ALL_TYPES or _ALL_TYPES
+    else:
+        types = _ALL_TYPES
+    if "integer" in types:
+        types = types | {"integer"}
+    enum: tuple = ()
+    if "const" in d:
+        enum = (d["const"],)
+    elif isinstance(d.get("enum"), list):
+        enum = tuple(d["enum"])
+    if enum and t is None:
+        # infer types from enum members so the start-char gate is tight
+        inferred = set()
+        for v in enum:
+            if isinstance(v, bool):
+                inferred.add("boolean")
+            elif isinstance(v, str):
+                inferred.add("string")
+            elif isinstance(v, (int, float)):
+                inferred.add("number")
+            elif v is None:
+                inferred.add("null")
+        if inferred:
+            types = frozenset(inferred)
+    props = tuple(
+        (k, compile_schema(v))
+        for k, v in (d.get("properties") or {}).items()
+    )
+    return Schema(
+        types=types,
+        properties=props,
+        required=frozenset(d.get("required") or ()),
+        additional=d.get("additionalProperties", True) is not False,
+        items=compile_schema(d["items"]) if isinstance(d.get("items"), dict)
+        else None,
+        enum=enum,
+    )
 
 
 @dataclass
@@ -35,10 +123,184 @@ class JsonValidator:
     stack: list = field(default_factory=lambda: ["start"])
     done: bool = False
     numbuf: str = ""
+    # schema enforcement (None = well-formed JSON only)
+    schema: Schema | None = None
+    sframes: list = field(default_factory=list)  # per-open-value frames
+    keybuf: str | None = None
 
     def clone(self) -> "JsonValidator":
-        return JsonValidator(stack=list(self.stack), done=self.done,
-                             numbuf=self.numbuf)
+        return JsonValidator(
+            stack=list(self.stack), done=self.done, numbuf=self.numbuf,
+            schema=self.schema,
+            sframes=[dict(fr, seen=set(fr["seen"])) if "seen" in fr
+                     else dict(fr) for fr in self.sframes],
+            keybuf=self.keybuf,
+        )
+
+    # -- schema plumbing ----------------------------------------------------
+
+    def _expected(self) -> Schema:
+        """Schema the value about to start must satisfy."""
+        if not self.sframes:
+            return self.schema or ANY_SCHEMA
+        fr = self.sframes[-1]
+        if fr["kind"] == "object":
+            return fr.get("pending") or ANY_SCHEMA
+        if fr["kind"] == "array":
+            return fr["schema"].items or ANY_SCHEMA
+        return ANY_SCHEMA
+
+    def _schema_value_start(self, c: str) -> bool:
+        if self.schema is None:
+            return True
+        s = self._expected()
+        if c == "{":
+            ok = "object" in s.types
+            fr = {"kind": "object", "schema": s, "seen": set()}
+        elif c == "[":
+            ok = "array" in s.types
+            fr = {"kind": "array", "schema": s}
+        elif c == '"':
+            ok = "string" in s.types
+            es = s.enum_strings() if s.enum else None
+            ok = ok and (es is None or len(es) > 0 or not s.enum)
+            fr = {"kind": "string", "schema": s, "buf": ""}
+        elif c in "-" + _DIGITS:
+            ok = "number" in s.types or "integer" in s.types
+            fr = {"kind": "number", "schema": s,
+                  "int_only": "number" not in s.types}
+        elif c in "tf":
+            word = "true" if c == "t" else "false"
+            ok = "boolean" in s.types and (
+                not s.enum or (word == "true") in [v for v in s.enum
+                                                  if isinstance(v, bool)]
+            )
+            fr = {"kind": "literal", "schema": s}
+        else:  # 'n'
+            ok = "null" in s.types and (not s.enum or None in s.enum)
+            fr = {"kind": "literal", "schema": s}
+        if not ok:
+            return False
+        self.sframes.append(fr)
+        return True
+
+    def _schema_string_char(self, c: str) -> bool:
+        """A raw (non-quote) char inside a value string."""
+        if self.schema is None or not self.sframes:
+            return True
+        fr = self.sframes[-1]
+        if fr["kind"] != "string":
+            return True
+        s: Schema = fr["schema"]
+        if not s.enum:
+            return True
+        if c == "\\":  # enum matching is raw-char; escapes can't extend it
+            return False
+        buf = fr["buf"] + c
+        if not any(m.startswith(buf) for m in s.enum_strings()):
+            return False
+        fr["buf"] = buf
+        return True
+
+    def _schema_string_end(self) -> bool:
+        if self.schema is None or not self.sframes:
+            return True
+        fr = self.sframes[-1]
+        if fr["kind"] != "string":
+            return True
+        s: Schema = fr["schema"]
+        return not s.enum or fr["buf"] in s.enum_strings()
+
+    def _schema_key_char(self, c: str) -> bool:
+        if self.schema is None:
+            return True
+        if self.keybuf is None:
+            self.keybuf = ""
+        fr = self.sframes[-1] if self.sframes else None
+        if fr is None or fr["kind"] != "object":
+            return True
+        s: Schema = fr["schema"]
+        if s.additional:
+            self.keybuf += c
+            return True
+        if c == "\\":
+            return False
+        buf = self.keybuf + c
+        if not any(p.startswith(buf) for p in s.prop_names()):
+            return False
+        self.keybuf = buf
+        return True
+
+    def _schema_key_done(self) -> bool:
+        if self.schema is None:
+            return True
+        fr = self.sframes[-1] if self.sframes else None
+        key, self.keybuf = (self.keybuf or ""), None
+        if fr is None or fr["kind"] != "object":
+            return True
+        s: Schema = fr["schema"]
+        prop = s.prop(key)
+        if prop is None and not s.additional:
+            return False
+        if key in fr["seen"]:
+            return False  # duplicate key under a schema is a violation
+        fr["pending"] = prop or ANY_SCHEMA
+        fr["pending_key"] = key
+        return True
+
+    def _schema_number_char(self, c: str) -> bool:
+        if self.schema is None or not self.sframes:
+            return True
+        fr = self.sframes[-1]
+        if fr["kind"] == "number" and fr.get("int_only") and c in ".eE":
+            return False
+        return True
+
+    def _schema_object_comma(self) -> bool:
+        """Veto ',' inside an object when no further key could follow —
+        additionalProperties is false and every property is already used
+        (otherwise the prefix dead-ends: no key char would be accepted)."""
+        if self.schema is None or not self.sframes:
+            return True
+        fr = self.sframes[-1]
+        if fr["kind"] != "object":
+            return True
+        s: Schema = fr["schema"]
+        if s.additional:
+            return True
+        return any(p not in fr["seen"] for p in s.prop_names())
+
+    def _schema_object_close(self) -> bool:
+        """Veto '}' while required keys are missing."""
+        if self.schema is None or not self.sframes:
+            return True
+        fr = self.sframes[-1]
+        if fr["kind"] != "object":
+            return True
+        return fr["schema"].required <= fr["seen"]
+
+    def _schema_value_end(self) -> bool:
+        """The innermost value just completed: final checks + bookkeeping."""
+        if self.schema is None:
+            return True
+        if not self.sframes:
+            return True
+        fr = self.sframes.pop()
+        if fr["kind"] == "number":
+            s: Schema = fr["schema"]
+            nums = s.enum_numbers() if s.enum else None
+            if nums is not None and s.enum:
+                try:
+                    if float(self.numbuf) not in nums:
+                        return False
+                except ValueError:
+                    return False
+        if self.sframes:
+            parent = self.sframes[-1]
+            if parent["kind"] == "object" and "pending_key" in parent:
+                parent["seen"].add(parent.pop("pending_key"))
+                parent.pop("pending", None)
+        return True
 
     _NUM_RE = __import__("re").compile(
         r"-?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?$"
@@ -48,6 +310,10 @@ class JsonValidator:
 
     def _push_value(self, c: str) -> bool:
         """Start a value with char c (top of stack expects a value)."""
+        if (c in '{["' or c in "-" + _DIGITS or c in "tfn") and (
+            not self._schema_value_start(c)
+        ):
+            return False
         if c == "{":
             self.stack.append("obj0")       # expect key or }
             return True
@@ -67,8 +333,10 @@ class JsonValidator:
                 return True
         return False
 
-    def _end_value(self):
+    def _end_value(self) -> bool:
         """A value just finished; fix up the container above."""
+        if not self._schema_value_end():
+            return False
         top = self.stack[-1] if self.stack else None
         if top == "start":
             self.stack.pop()
@@ -77,6 +345,7 @@ class JsonValidator:
             self.stack[-1] = "obj_after"
         elif top in ("arr0", "arr_elem"):
             self.stack[-1] = "arr_after"
+        return True
 
     def feed(self, text: str) -> bool:
         """Consume text; returns False (and poisons state) on violation."""
@@ -97,13 +366,27 @@ class JsonValidator:
             if ord(c) < 0x20:          # raw control chars are invalid in JSON
                 return False
             if c == "\\":
+                if top == "vstr" and not self._schema_string_char(c):
+                    return False
+                if top == "kstr" and not self._schema_key_char(c):
+                    return False
                 self.stack.append("esc")
             elif c == '"':
                 self.stack.pop()
                 if top == "kstr":
+                    if not self._schema_key_done():
+                        return False
                     self.stack[-1] = "objk_done"   # expect ':'
                 else:
-                    self._end_value()
+                    if not self._schema_string_end():
+                        return False
+                    if not self._end_value():
+                        return False
+            else:
+                if top == "vstr" and not self._schema_string_char(c):
+                    return False
+                if top == "kstr" and not self._schema_key_char(c):
+                    return False
             return True
         if top == "esc":
             self.stack.pop()
@@ -122,6 +405,8 @@ class JsonValidator:
             return True
         if top == "num":
             if c in _DIGITS + ".eE+-":
+                if not self._schema_number_char(c):
+                    return False
                 self.numbuf += c
                 # reject impossible prefixes early (e.g. leading zeros)
                 probe = self.numbuf.rstrip("eE+-.")
@@ -131,7 +416,8 @@ class JsonValidator:
             if self._NUM_RE.match(self.numbuf) is None:
                 return False  # e.g. "5e" or "1." with no digits
             self.stack.pop()
-            self._end_value()
+            if not self._end_value():
+                return False
             return self._feed_char(c) if not self.done else (c in _WS)
         if top.startswith("lit:"):
             _, word, pos = top.split(":")
@@ -139,7 +425,8 @@ class JsonValidator:
             if pos < len(word) and c == word[pos]:
                 if pos + 1 == len(word):
                     self.stack.pop()
-                    self._end_value()
+                    if not self._end_value():
+                        return False
                 else:
                     self.stack[-1] = f"lit:{word}:{pos + 1}"
                 return True
@@ -156,9 +443,10 @@ class JsonValidator:
                 self.stack.append("kstr")
                 return True
             if c == "}":
+                if not self._schema_object_close():
+                    return False
                 self.stack.pop()
-                self._end_value()
-                return True
+                return self._end_value()
             return False
         if top == "objk_done":               # key string closed: expect ':'
             if c == ":":
@@ -169,17 +457,21 @@ class JsonValidator:
             return self._push_value(c)
         if top == "obj_after":               # value done: ',' or '}'
             if c == ",":
+                if not self._schema_object_comma():
+                    return False
                 self.stack[-1] = "obj0"
                 return True
             if c == "}":
+                if not self._schema_object_close():
+                    return False
                 self.stack.pop()
-                self._end_value()
-                return True
+                return self._end_value()
             return False
         if top == "arr0":                    # [ seen: value or ]
             if c == "]":
                 self.stack.pop()
-                self._end_value()
+                if not self._end_value():
+                    return False
                 return True
             return self._push_value(c)
         if top == "arr_elem":                # after ',': value required
@@ -190,7 +482,8 @@ class JsonValidator:
                 return True
             if c == "]":
                 self.stack.pop()
-                self._end_value()
+                if not self._end_value():
+                    return False
                 return True
             return False
         return False
@@ -225,9 +518,13 @@ def generate_json(
     prompt_ids: list[int],
     max_new_tokens: int = 256,
     top_candidates: int = 64,
+    schema: dict | None = None,
 ) -> str:
     """Greedy JSON-constrained decoding: each step picks the highest-logit
-    token whose text keeps the output a valid JSON prefix."""
+    token whose text keeps the output a valid JSON prefix — and, when a
+    ``schema`` dict is given, a valid prefix of a schema-conforming
+    document (types, properties/required/additionalProperties, items,
+    enum/const)."""
     from ipex_llm_tpu import kv as kv_mod
     from ipex_llm_tpu.generation import _round_up, prefill_step
     from ipex_llm_tpu.models.decoder import decoder_forward
@@ -238,28 +535,45 @@ def generate_json(
     toks[0, tpad - n_p:] = prompt_ids
     cap = tpad + max_new_tokens + 8
     cache = kv_mod.make_cache("normal", cfg.num_layers, 1, cap,
-                              cfg.num_kv_heads, cfg.head_dim)
+                              cfg.num_kv_heads, cfg.head_dim,
+                              v_head_dim=cfg.v_dim)
     logits, cache = prefill_step(
         cfg, params, cache, jnp.asarray(toks), jnp.asarray([n_p], np.int32)
     )
     kv_start = jnp.asarray([tpad - n_p], np.int32)
 
-    validator = JsonValidator()
+    validator = JsonValidator(
+        schema=compile_schema(schema) if schema is not None else None
+    )
     text = ""
     out_ids: list[int] = []
     for step in range(max_new_tokens):
         lg = np.asarray(logits, np.float32).reshape(-1)
-        order = np.argsort(-lg)[:top_candidates]
+        order = np.argsort(-lg)
         chosen = None
-        for tid in order:
-            piece = tokenizer.decode([int(tid)])
-            v2 = validator.clone()
-            if piece and v2.feed(piece):
-                chosen = int(tid)
-                validator = v2
+        # fast path: top candidates; grammar-forcing fallback: whole vocab
+        # (a constrained grammar often needs a token the model ranks low,
+        # e.g. the schema-required '{' — giving up there would return an
+        # empty/truncated document)
+        # outside strings JSON never *requires* whitespace — skip pure-WS
+        # pieces there so the token budget goes to structure, not padding
+        in_string = validator.stack and validator.stack[-1] in (
+            "vstr", "kstr", "esc"
+        )
+        for limit in (top_candidates, len(order)):
+            for tid in order[:limit]:
+                piece = tokenizer.decode([int(tid)])
+                if not piece or (not in_string and piece.strip() == ""):
+                    continue
+                v2 = validator.clone()
+                if v2.feed(piece):
+                    chosen = int(tid)
+                    validator = v2
+                    break
+            if chosen is not None:
                 break
         if chosen is None:
-            break  # no valid continuation in the candidate set
+            break  # no token in the vocabulary continues the grammar
         out_ids.append(chosen)
         text += tokenizer.decode([chosen])
         if validator.done:
@@ -270,4 +584,32 @@ def generate_json(
             cfg, params, tok, cache, pos, kv_start=kv_start,
             last_token_only=True,
         )
+
+    if not validator.done:
+        # grammar-forced closure (the xgrammar "forced token" idea): the
+        # budget ran out mid-document, so close every open construct with
+        # validator-approved characters — output stays parseable and
+        # schema-conforming even on truncation
+        alphabet = ('"}]' + "0123456789" + ":,"
+                    + "abcdefghijklmnopqrstuvwxyz"
+                    + "ABCDEFGHIJKLMNOPQRSTUVWXYZ" + "{[-.tfn _")
+        for _ in range(256):
+            if validator.done:
+                break
+            if validator.could_end():
+                # a top-level number has no closing delimiter; trailing
+                # whitespace is its terminator
+                v2 = validator.clone()
+                if v2.feed(" ") and v2.done:
+                    validator = v2
+                    text += " "
+                    continue
+            for c in alphabet:
+                v2 = validator.clone()
+                if v2.feed(c):
+                    validator = v2
+                    text += c
+                    break
+            else:
+                break  # dead end: nothing closes (e.g. unmet required key)
     return text

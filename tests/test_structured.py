@@ -83,3 +83,105 @@ def test_generate_json_produces_valid_json():
     assert v.feed(out)
     if v.done:
         json.loads(out)  # fully-formed output must parse
+
+
+# ---------------------------------------------------------------------------
+# schema-aware constrained decoding (VERDICT r2 item 9; reference
+# xgrammar.py:21-47 intent)
+# ---------------------------------------------------------------------------
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "a": {"type": "integer"},
+        "b": {"type": "string", "enum": ["x", "yz"]},
+        "c": {"type": "array", "items": {"type": "number"}},
+    },
+    "required": ["a"],
+    "additionalProperties": False,
+}
+
+
+def _sv():
+    from ipex_llm_tpu.structured import JsonValidator, compile_schema
+
+    return JsonValidator(schema=compile_schema(SCHEMA))
+
+
+@pytest.mark.parametrize("text", [
+    '{"a": 3}',
+    '{"a": -12, "b": "yz"}',
+    '{"b": "x", "a": 0}',
+    '{"a": 1, "c": [1, 2.5]}',
+])
+def test_schema_accepts_conforming(text):
+    v = _sv()
+    assert v.feed(text), text
+    assert v.done
+    json.loads(text)
+
+
+@pytest.mark.parametrize("text,why", [
+    ('{"b": "x"}', "missing required key a"),
+    ('{"a": 1.5}', "a must be integer"),
+    ('{"a": "1"}', "a must not be a string"),
+    ('{"a": 1, "b": "q"}', "q not an enum prefix"),
+    ('{"a": 1, "b": "y"}', "y is a strict prefix of yz, not a member"),
+    ('{"a": 1, "d": 2}', "unknown key with additionalProperties false"),
+    ('{"a": 1, "c": ["s"]}', "items must be numbers"),
+    ('{"a": 1, "a": 2}', "duplicate key"),
+    ('[1]', "top level must be an object"),
+    ('"s"', "top level must be an object"),
+])
+def test_schema_rejects_valid_json_invalid_schema(text, why):
+    """Every case is VALID JSON — only the schema rejects it."""
+    json.loads(text)  # precondition: well-formed
+    v = _sv()
+    ok = v.feed(text)
+    assert not (ok and v.done), why
+
+
+def test_schema_prefix_stays_alive():
+    """Conforming prefixes must never dead-end mid-generation."""
+    v = _sv()
+    for c in '{"a": 17, "c": [3, ':
+        assert v.feed(c), c
+    assert not v.done
+
+
+def test_schema_enum_const():
+    from ipex_llm_tpu.structured import JsonValidator, compile_schema
+
+    sch = compile_schema({"const": "only"})
+    v = JsonValidator(schema=sch)
+    assert v.feed('"only"') and v.done
+    v2 = JsonValidator(schema=sch)
+    assert not (v2.feed('"other"') and v2.done)
+    v3 = JsonValidator(schema=sch)
+    assert not (v3.feed("3") and v3.done)
+
+
+def test_generate_json_with_schema():
+    from ipex_llm_tpu.structured import generate_json
+
+    cfg = tiny_cfg(vocab_size=128, hidden_size=32, intermediate_size=64,
+                   num_heads=4, num_kv_heads=2, head_dim=8)
+    params = rand_params(cfg, qtype="bf16")
+
+    class CharTok:
+        chars = (' {}[]:,"0123456789.-+eE'
+                 "abcdefghijklmnopqrstuvwxyz"
+                 "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+
+        def decode(self, ids):
+            return "".join(self.chars[i % len(self.chars)] for i in ids)
+
+    schema = {"type": "object", "properties": {"n": {"type": "integer"}},
+              "required": ["n"], "additionalProperties": False}
+    out = generate_json(cfg, params, CharTok(), list(range(30, 46)),
+                        max_new_tokens=80, schema=schema)
+    # full-vocab grammar forcing: the document must complete and conform
+    doc = json.loads(out)
+    assert isinstance(doc, dict)
+    assert set(doc) == {"n"}
+    assert isinstance(doc["n"], int)
