@@ -7,7 +7,7 @@ vectorized numpy repacks into the QTensor planes of quantize/core.py:
 
 - q4_0 → sym_int4 and q8_0 → sym_int8 and q4_1 → asym_int4 are **bit-exact**
   (same 32-block, same nibble-halves pairing, fp16 scales preserved);
-- q5_0/q5_1 → sym_int5/asym_int5 are bit-exact (codes one-per-byte);
+- q5_0/q5_1 → sym_int5/asym_int5 are bit-exact (packed 4+1-bit planes);
 - k-quants (q2_k..q6_k) keep their raw superblock bytes and decode in-jit
   (quantize/kquants.py);
 - f16/f32/bf16 pass through as dense arrays.
@@ -81,7 +81,9 @@ def _q5_0(raw: np.ndarray, out: int, n_in: int) -> QTensor:
     b = _blocks(raw, out, 22)
     d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])
     codes = _q5_codes(b, 6)
-    data = codes.reshape(out, -1).T.copy()                     # one per byte
+    from ipex_llm_tpu.quantize.core import _pack_5bit
+
+    data = _pack_5bit(np.ascontiguousarray(codes.reshape(out, -1).T), 32)
     return QTensor(data, d.T.astype(np.float16), None, "sym_int5",
                    (n_in, out), 32)
 
@@ -91,7 +93,9 @@ def _q5_1(raw: np.ndarray, out: int, n_in: int) -> QTensor:
     d = _f16(b[:, :, 0:2].copy().view(np.uint16)[:, :, 0])
     m = _f16(b[:, :, 2:4].copy().view(np.uint16)[:, :, 0])
     codes = _q5_codes(b, 8)
-    data = codes.reshape(out, -1).T.copy()
+    from ipex_llm_tpu.quantize.core import _pack_5bit
+
+    data = _pack_5bit(np.ascontiguousarray(codes.reshape(out, -1).T), 32)
     return QTensor(data, d.T.astype(np.float16), m.T.astype(np.float16),
                    "asym_int5", (n_in, out), 32)
 

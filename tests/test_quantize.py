@@ -147,3 +147,56 @@ def test_zero_block_stability():
         rec = np.asarray(dequantize(quantize(w, qtype)))
         assert np.all(np.isfinite(rec))
         np.testing.assert_allclose(rec, 0.0, atol=1e-6)
+
+
+def test_every_advertised_qtype_roundtrips():
+    """VERDICT r2 item 8: every name in all_qtypes() must actually work.
+
+    'Work' = quantize+dequantize a weight (block formats), cast (native),
+    or decode imported raw bytes (kquants, exercised in test_kquants); the
+    i-quants that cannot be decoded were removed from the advertised set
+    but keep their reference ids for table parity.
+    """
+    import numpy as np
+
+    from ipex_llm_tpu.quantize import (
+        all_qtypes, dequantize, ggml_tensor_qtype, quantize, resolve,
+    )
+    from ipex_llm_tpu.quantize.qtypes import UNSUPPORTED_QTYPE_IDS
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((256, 16)).astype(np.float32)
+    for name in all_qtypes():
+        info = resolve(name)  # never raises for advertised names
+        if info.kind == "kquant":
+            continue  # decode-only import formats; covered by test_kquants
+        qt = quantize(w, name)
+        back = np.asarray(dequantize(qt))
+        assert back.shape == w.shape, name
+        err = np.abs(back - w).mean() / np.abs(w).mean()
+        assert err < 0.25, (name, err)  # nf3 (3-bit) sits near 0.20
+
+    # i-quants: recognized ids, loud targeted failure, not advertised
+    for name, qid in UNSUPPORTED_QTYPE_IDS.items():
+        assert ggml_tensor_qtype[name] == qid
+        assert name not in all_qtypes()
+        import pytest as _pytest
+
+        with _pytest.raises(NotImplementedError):
+            resolve(name)
+
+
+def test_int5_is_actually_packed():
+    """sym/asym_int5 must store ~5 bits/weight, not a byte per code."""
+    import numpy as np
+
+    from ipex_llm_tpu.quantize import dequantize, quantize
+
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((256, 8)).astype(np.float32)
+    for name in ("sym_int5", "asym_int5"):
+        qt = quantize(w, name)
+        assert qt.data.shape[0] == 256 // 2 + 256 // 8, name  # 0.625 B/weight
+        back = np.asarray(dequantize(qt))
+        err = np.abs(back - w).mean() / np.abs(w).mean()
+        assert err < 0.05, (name, err)
