@@ -26,6 +26,9 @@ except ImportError:
             def __call__(self, prompt: str, stop=None, **kw) -> str:
                 return self._call(prompt, stop=stop, **kw)
 
+            def invoke(self, prompt: str, stop=None, **kw) -> str:
+                return self._call(prompt, stop=stop, **kw)
+
 
 class TransformersLLM(_LCBase):
     """LangChain LLM backed by ipex_llm_tpu (reference transformersllm.py:61)."""
