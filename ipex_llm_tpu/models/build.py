@@ -63,6 +63,7 @@ def build_params(
     moe_scheme=None,
     embedding_qtype: str | None = None,
     qkv_transform: Callable | None = None,
+    transpose_weights: bool = False,
 ) -> dict[str, Any]:
     """Assemble the full decoder param pytree, quantizing as it streams.
 
@@ -75,6 +76,12 @@ def build_params(
             return None
         return t.format(i=i, p=p)
 
+    def getp(n: str) -> np.ndarray:
+        """Projection-weight getter: gpt2-style Conv1D checkpoints store
+        [in, out] and transpose here back to the HF Linear [out, in]."""
+        w = get(n)
+        return np.ascontiguousarray(w.T) if transpose_weights else w
+
     def get_opt(n: str | None) -> np.ndarray | None:
         if n is None or not has(n):
             return None
@@ -82,6 +89,15 @@ def build_params(
 
     def norm_with_bias(lp: dict, key: str, tmpl: str | None, i: int | None,
                        required: bool = False):
+        if tmpl is not None and "|" in tmpl:
+            # "a|b" templates: families whose checkpoints use either name
+            # (falcon old/new decoder architecture)
+            for alt in tmpl.split("|"):
+                if has(alt.format(i=i)):
+                    tmpl = alt
+                    break
+            else:
+                tmpl = tmpl.split("|")[0]
         n = name(tmpl, i)
         if n is None or (not required and not has(n)):
             return
@@ -134,7 +150,7 @@ def build_params(
             lp["kv_b"] = quantize_weight(get(name(scheme.kv_b, i)), qtype)
         # --- qkv (merge like reference _optimize_pre merge_qkv, convert.py:890)
         elif scheme.qkv is not None:
-            qkv_w = get(name(scheme.qkv, i))
+            qkv_w = getp(name(scheme.qkv, i))
             qkv_b = get_opt(name(scheme.qkv, i, "bias"))
             if qkv_transform is not None:
                 # family-specific packed layout (gpt-neox interleave,
@@ -143,9 +159,9 @@ def build_params(
                 if qkv_b is not None:
                     qkv_b = qkv_transform(qkv_b[:, None], cfg)[:, 0]
         else:
-            qw = get(name(scheme.q, i))
-            kw = get(name(scheme.k, i))
-            vw = get(name(scheme.v, i))
+            qw = getp(name(scheme.q, i))
+            kw = getp(name(scheme.k, i))
+            vw = getp(name(scheme.v, i))
             qkv_w = np.concatenate([qw, kw, vw], axis=0)  # [out_total, in]
             bs = [get_opt(name(t, i, "bias")) for t in (scheme.q, scheme.k, scheme.v)]
             qkv_b = np.concatenate(bs) if bs[0] is not None else None
@@ -154,7 +170,7 @@ def build_params(
             if qkv_b is not None:
                 lp["qkv_bias"] = jnp.asarray(qkv_b, jnp.float32)
 
-        ow = get(name(scheme.o, i))
+        ow = getp(name(scheme.o, i))
         lp["o"] = quantize_weight(ow, qtype)
         ob = get_opt(name(scheme.o, i, "bias"))
         if ob is not None:
@@ -201,11 +217,11 @@ def build_params(
 
         # --- non-gated mlp (phi/gpt-neox/starcoder2: fc1 -> act -> fc2)
         if scheme.gate_up is None and scheme.gate is None:
-            lp["up"] = quantize_weight(get(name(scheme.up, i)), qtype)
+            lp["up"] = quantize_weight(getp(name(scheme.up, i)), qtype)
             ub = get_opt(name(scheme.up, i, "bias"))
             if ub is not None:
                 lp["up_bias"] = jnp.asarray(ub, jnp.float32)
-            lp["down"] = quantize_weight(get(name(scheme.down, i)), qtype)
+            lp["down"] = quantize_weight(getp(name(scheme.down, i)), qtype)
             db = get_opt(name(scheme.down, i, "bias"))
             if db is not None:
                 lp["down_bias"] = jnp.asarray(db, jnp.float32)
@@ -214,11 +230,11 @@ def build_params(
 
         # --- mlp (merged gate_up)
         if scheme.gate_up is not None:
-            gu_w = get(name(scheme.gate_up, i))
+            gu_w = getp(name(scheme.gate_up, i))
             gu_b = get_opt(name(scheme.gate_up, i, "bias"))
         else:
-            gw = get(name(scheme.gate, i))
-            uw = get(name(scheme.up, i))
+            gw = getp(name(scheme.gate, i))
+            uw = getp(name(scheme.up, i))
             gu_w = np.concatenate([gw, uw], axis=0)
             gb = get_opt(name(scheme.gate, i, "bias"))
             ub = get_opt(name(scheme.up, i, "bias"))
@@ -226,7 +242,7 @@ def build_params(
         lp["gate_up"] = quantize_weight(gu_w, qtype)
         if gu_b is not None:
             lp["gate_up_bias"] = jnp.asarray(gu_b, jnp.float32)
-        lp["down"] = quantize_weight(get(name(scheme.down, i)), qtype)
+        lp["down"] = quantize_weight(getp(name(scheme.down, i)), qtype)
         db = get_opt(name(scheme.down, i, "bias"))
         if db is not None:
             lp["down_bias"] = jnp.asarray(db, jnp.float32)
@@ -249,6 +265,17 @@ def build_params(
         params["embed"] = qcore.quantize(get(scheme.embed), embedding_qtype)
     else:
         params["embed"] = jnp.asarray(get(scheme.embed), jnp.bfloat16)
+    if scheme.pos_embed is not None and has(scheme.pos_embed):
+        pe = get(scheme.pos_embed)
+        if cfg.learned_pos and pe.shape[0] > cfg.learned_pos:
+            # OPT offsets learned positions by 2: slice the pad rows off
+            pe = pe[pe.shape[0] - cfg.learned_pos :]
+        params["pos_embed"] = jnp.asarray(pe, jnp.bfloat16)
+    if scheme.embed_norm is not None and has(scheme.embed_norm):
+        params["embed_norm"] = jnp.asarray(get(scheme.embed_norm), NORM_DTYPE)
+        enb = scheme.embed_norm[: -len(".weight")] + ".bias"
+        if has(enb):
+            params["embed_norm_bias"] = jnp.asarray(get(enb), NORM_DTYPE)
     params["final_norm"] = jnp.asarray(get(scheme.final_norm), NORM_DTYPE)
     fn_bias = scheme.final_norm[: -len(".weight")] + ".bias"
     if scheme.final_norm.endswith(".weight") and has(fn_bias):

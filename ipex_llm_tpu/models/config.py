@@ -52,6 +52,15 @@ class ModelConfig:
     mlp_gated: bool = True        # False: fc1 -> act -> fc2 (phi/gptneox)
     parallel_blocks: bool = False  # x + attn(ln(x)) + mlp(ln'(x)) (phi/neox)
 
+    # position encodings beyond rope
+    alibi: bool = False            # bloom/mpt/baichuan-13b linear biases
+    learned_pos: int = 0           # >0: learned absolute embeddings (gpt2/opt)
+
+    # block/embedding variants
+    embed_norm: bool = False       # bloom word_embeddings_layernorm
+    norm_after: bool = False       # olmo2: x + norm(attn(x)) (no input norm)
+    logit_scale: float = 1.0       # cohere final-logit multiplier
+
     # attention extras
     sliding_window: int | None = None
     layer_types: tuple[str, ...] | None = None  # per-layer 'full'|'sliding'

@@ -46,15 +46,34 @@ def _norm(x, w, cfg: ModelConfig, bias=None):
     return rms_norm(x, w, cfg.norm_eps, cfg.norm_offset)
 
 
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (the reference patches bloom/baichuan-13b to
+    keep HF's ``build_alibi_tensor`` semantics; same closed form here)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    n_p2 = 2 ** math.floor(math.log2(n_heads))
+    slopes = pow2_slopes(n_p2)
+    if n_p2 != n_heads:
+        extra = pow2_slopes(2 * n_p2)
+        slopes += extra[0::2][: n_heads - n_p2]
+    return jnp.asarray(slopes, jnp.float32)
+
+
 def _in_norm(x, lp, key, cfg):
     return _norm(x, lp[key], cfg, lp.get(key + "_bias"))
 
 
 def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
                      q_slots, kv_len, kv_start, sliding, cache: KVCache,
-                     collect_obs: int = 0):
+                     collect_obs: int = 0, bias=None):
     b, t, _ = x.shape
-    h = _in_norm(x, lp, "attn_norm", cfg)
+    # olmo2-style reordered norm: attention sees the raw residual stream
+    # and attn_norm applies to the block OUTPUT instead
+    h = x if cfg.norm_after else _in_norm(x, lp, "attn_norm", cfg)
     q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
     if cfg.is_mla:
         # DeepSeek MLA (reference deepseek.py:274-343): low-rank q, a
@@ -113,11 +132,15 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
         q = linear_ops.linear(h, lp["q"], lp.get("q_bias"))
         k = linear_ops.linear(h, lp["k"], lp.get("k_bias"))
         v = linear_ops.linear(h, lp["v"], lp.get("v_bias"))
+    if cfg.qk_norm and lp["q_norm"].shape[-1] == q_dim:
+        # olmo2-style flat q/k rmsnorm over the whole projection
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_offset)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_offset)
     q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
     k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
 
-    if cfg.qk_norm:
+    if cfg.qk_norm and lp["q_norm"].shape[-1] == cfg.head_dim:
         q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_offset)
         k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_offset)
 
@@ -157,9 +180,12 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
         window_on=sliding,
         softcap=cfg.attn_softcap,
         scale=cfg.attn_scale,
+        bias=bias,
     )
     attn = attn.reshape(b, t, cfg.num_heads * cfg.head_dim)
     out = linear_ops.linear(attn, lp["o"], lp.get("o_bias"))
+    if cfg.norm_after:
+        out = _norm(out, lp["attn_norm"], cfg, lp.get("attn_norm_bias"))
     if cfg.post_attn_norm:
         out = _norm(out, lp["post_attn_norm"], cfg)
     return out, kl, vl, obs_q
@@ -253,7 +279,7 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
 
 
 def _mlp_block(cfg: ModelConfig, lp: dict, x):
-    h = _in_norm(x, lp, "mlp_norm", cfg)
+    h = x if cfg.norm_after else _in_norm(x, lp, "mlp_norm", cfg)
     if not cfg.mlp_gated:
         # fc1 -> act -> fc2 (phi/gptneox/starcoder2-style MLP)
         inner = mlp_ops.act(
@@ -268,6 +294,8 @@ def _mlp_block(cfg: ModelConfig, lp: dict, x):
             up = linear_ops.linear(h, lp["up"], lp.get("up_bias"))
         inner = mlp_ops.gated_act_mul(gate, up, cfg.act)
     out = linear_ops.linear(inner, lp["down"], lp.get("down_bias"))
+    if cfg.norm_after:
+        out = _norm(out, lp["mlp_norm"], cfg, lp.get("mlp_norm_bias"))
     if cfg.post_mlp_norm:
         out = _norm(out, lp["post_mlp_norm"], cfg)
     return out
@@ -303,6 +331,12 @@ def decoder_forward(
     x = embed_lookup(embed, tokens, COMPUTE_DTYPE)
     if cfg.embedding_multiplier != 1.0:
         x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
+    if cfg.learned_pos:
+        # gpt2/opt absolute positions: logical (left-pad-aware) indices
+        pos_clip = jnp.clip(rope_positions, 0, cfg.learned_pos - 1)
+        x = x + params["pos_embed"][pos_clip].astype(COMPUTE_DTYPE)
+    if cfg.embed_norm:  # bloom word_embeddings_layernorm
+        x = _norm(x, params["embed_norm"], cfg, params.get("embed_norm_bias"))
 
     cos, sin = (None, None)
     if cfg.rope is not None:
@@ -318,6 +352,8 @@ def decoder_forward(
             rope_positions, frozen("inv_freq"), frozen("rope_mscale", 1.0)
         )
 
+    alibi_bias = None
+
     if slot_offsets is not None:
         slot0 = slot_offsets                       # [B]
         q_slots = slot0[:, None] + jnp.arange(t)[None, :]
@@ -327,6 +363,18 @@ def decoder_forward(
         q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
         kv_len = jnp.broadcast_to(slot0 + t, (b,))
 
+    if cfg.alibi:
+        # ALiBi (bloom/mpt/baichuan-13b): slope * (k_pos - q_pos), identical
+        # for every layer — built ONCE here (like cos/sin), never inside the
+        # scan body.  Slot arithmetic cancels kv_start, so left-padding is
+        # transparent.
+        s = cache.max_len
+        slopes = alibi_slopes(cfg.num_heads)
+        kv_pos = jnp.arange(s, dtype=jnp.float32)
+        dist = kv_pos[None, None, None, :] - q_slots.astype(jnp.float32)[
+            :, None, :, None]                       # [B,1,T,S] (<=0 causal)
+        alibi_bias = slopes[None, :, None, None] * dist
+
     sliding_flags = jnp.array(
         [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
     )
@@ -335,7 +383,7 @@ def decoder_forward(
         lp, kl, vl, sliding = xs
         attn_out, kl, vl, obs_q = _attention_block(
             cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
-            sliding, cache, collect_obs,
+            sliding, cache, collect_obs, bias=alibi_bias,
         )
         ffn = _moe_block if "moe_gate_up" in lp else _mlp_block
         if cfg.parallel_blocks:
@@ -384,6 +432,8 @@ def decoder_forward(
         logits = linear_ops.linear(
             x, lm_head, params.get("lm_head_bias")
         ).astype(jnp.float32)
+    if cfg.logit_scale != 1.0:  # cohere
+        logits = logits * cfg.logit_scale
     if cfg.logit_softcap is not None:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
 

@@ -42,6 +42,8 @@ class WeightScheme:
     down: str = "model.layers.{i}.mlp.down_proj.{p}"
     q_norm: str | None = None
     k_norm: str | None = None
+    pos_embed: str | None = None   # learned absolute positions (gpt2 wpe)
+    embed_norm: str | None = None  # bloom word_embeddings_layernorm
     # MLA (deepseek): q (or q_a/q_b low-rank pair), kv_a, kv_b replace q/k/v
     q_a: str | None = None
     q_a_norm: str | None = None
@@ -75,6 +77,8 @@ class Family:
     # packed-qkv layout fixup -> [q_all; k_all; v_all] rows (applied before
     # quantization; the _optimize_pre weight-rewrite equivalent)
     qkv_transform: Callable | None = None
+    # gpt2-style Conv1D checkpoints store projections [in, out]
+    transpose_weights: bool = False
 
 
 def _rope_from_hf(hf: dict, head_dim: int) -> RopeScaling:
@@ -381,15 +385,175 @@ def _starcoder2(hf: dict) -> ModelConfig:
 
 def _baichuan(hf: dict) -> ModelConfig:
     if hf.get("hidden_size", 0) >= 5120:
-        raise NotImplementedError(
-            "baichuan-13B uses ALiBi position encoding (not supported yet); "
-            "the 7B rope variants load fine"
-        )
+        # baichuan-13B: ALiBi instead of rope (reference baichuan.py
+        # patches); the W_pack layout is unchanged
+        return ModelConfig(**_base_cfg(hf, rope=None, alibi=True))
     return ModelConfig(**_base_cfg(hf))
 
 
 def _internlm2(hf: dict) -> ModelConfig:
     return ModelConfig(**_base_cfg(hf, attention_bias=hf.get("bias", False)))
+
+
+def _bloom(hf: dict) -> ModelConfig:
+    """bloom: ALiBi, no rope, layernorm everywhere incl. an embedding
+    layernorm, fused per-head-interleaved QKV (reference bloom patches)."""
+    h = hf["hidden_size"]
+    hf2 = dict(
+        model_type="bloom", vocab_size=hf["vocab_size"], hidden_size=h,
+        intermediate_size=hf.get("intermediate_size") or 4 * h,
+        num_hidden_layers=hf.get("n_layer", hf.get("num_hidden_layers")),
+        num_attention_heads=hf.get("n_head", hf.get("num_attention_heads")),
+        layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=2048,
+    )
+    return ModelConfig(**_base_cfg(
+        hf2, rope=None, alibi=True, embed_norm=True,
+        norm_kind="layer", act="gelu_new", mlp_gated=False,
+        attention_bias=True, attention_out_bias=True, mlp_bias=True,
+        tie_word_embeddings=True,
+    ))
+
+
+def _mpt(hf: dict) -> ModelConfig:
+    """mpt: ALiBi (attn_config), no biases, exact-gelu MLP."""
+    h = hf["d_model"]
+    attn = hf.get("attn_config") or {}
+    hf2 = dict(
+        model_type="mpt", vocab_size=hf["vocab_size"], hidden_size=h,
+        intermediate_size=int(hf.get("expansion_ratio", 4) * h),
+        num_hidden_layers=hf["n_layers"], num_attention_heads=hf["n_heads"],
+        layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("max_seq_len", 2048),
+    )
+    if not attn.get("alibi", True):
+        raise NotImplementedError("mpt without alibi (learned pos) unsupported")
+    return ModelConfig(**_base_cfg(
+        hf2, rope=None, alibi=True, norm_kind="layer", act="gelu",
+        mlp_gated=False, tie_word_embeddings=True,
+    ))
+
+
+def _gpt2(hf: dict) -> ModelConfig:
+    h = hf["n_embd"]
+    hf2 = dict(
+        model_type="gpt2", vocab_size=hf["vocab_size"], hidden_size=h,
+        intermediate_size=hf.get("n_inner") or 4 * h,
+        num_hidden_layers=hf["n_layer"], num_attention_heads=hf["n_head"],
+        layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("n_positions", 1024),
+    )
+    return ModelConfig(**_base_cfg(
+        hf2, rope=None, learned_pos=hf.get("n_positions", 1024),
+        norm_kind="layer", act=hf.get("activation_function", "gelu_new"),
+        mlp_gated=False, attention_bias=True, attention_out_bias=True,
+        mlp_bias=True, tie_word_embeddings=True,
+    ))
+
+
+def _opt(hf: dict) -> ModelConfig:
+    if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+        raise NotImplementedError("OPT word_embed projections unsupported")
+    if not hf.get("do_layer_norm_before", True):
+        raise NotImplementedError("OPT-350m post-norm layout unsupported")
+    hf2 = dict(hf)
+    hf2["intermediate_size"] = hf.get("ffn_dim", 4 * hf["hidden_size"])
+    return ModelConfig(**_base_cfg(
+        hf2, rope=None,
+        learned_pos=hf.get("max_position_embeddings", 2048),
+        norm_kind="layer", act=hf.get("activation_function", "relu"),
+        mlp_gated=False,
+        attention_bias=hf.get("enable_bias", True),
+        attention_out_bias=hf.get("enable_bias", True),
+        mlp_bias=hf.get("enable_bias", True),
+        tie_word_embeddings=True,
+    ))
+
+
+def _gptj(hf: dict) -> ModelConfig:
+    h = hf["n_embd"]
+    head_dim = h // hf["n_head"]
+    hf2 = dict(
+        model_type="gptj", vocab_size=hf["vocab_size"], hidden_size=h,
+        intermediate_size=hf.get("n_inner") or 4 * h,
+        num_hidden_layers=hf["n_layer"], num_attention_heads=hf["n_head"],
+        layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("n_positions", 2048),
+        partial_rotary_factor=hf.get("rotary_dim", head_dim) / head_dim,
+    )
+    return ModelConfig(**_base_cfg(
+        hf2, rope_layout="two", norm_kind="layer",
+        act=hf.get("activation_function", "gelu_new"), mlp_gated=False,
+        parallel_blocks=True, mlp_bias=True,
+    ))
+
+
+def _cohere(hf: dict) -> ModelConfig:
+    if hf.get("use_qk_norm"):
+        raise NotImplementedError("cohere use_qk_norm variant unsupported")
+    return ModelConfig(**_base_cfg(
+        hf,
+        rope_layout="two",               # cohere applies rope interleaved
+        norm_kind="layer",               # LayerNorm without bias
+        norm_eps=hf.get("layer_norm_eps", 1e-5),
+        parallel_blocks=True,            # x + attn(ln(x)) + mlp(ln(x))
+        logit_scale=hf.get("logit_scale", 1.0),
+        tie_word_embeddings=True,
+    ))
+
+
+def _stablelm(hf: dict) -> ModelConfig:
+    if hf.get("qk_layernorm") or hf.get("use_parallel_residual"):
+        raise NotImplementedError(
+            "stablelm qk_layernorm / parallel-residual variants (e.g. "
+            "stablelm-2-12b) are not supported yet"
+        )
+    return ModelConfig(**_base_cfg(
+        hf,
+        norm_kind="layer",
+        norm_eps=hf.get("layer_norm_eps", 1e-5),
+        attention_bias=hf.get("use_qkv_bias", False),
+        attention_out_bias=False,
+    ))
+
+
+def _olmo2(hf: dict) -> ModelConfig:
+    return ModelConfig(**_base_cfg(
+        hf,
+        qk_norm=True,        # flat RMSNorm over the whole q/k projection
+        norm_after=True,     # x + norm(attn(x)) reordered-norm blocks
+    ))
+
+
+def _falcon(hf: dict) -> ModelConfig:
+    h = hf["hidden_size"]
+    new_arch = hf.get("new_decoder_architecture", False)
+    if new_arch:
+        kv = hf.get("num_kv_heads") or hf["num_attention_heads"]
+    elif hf.get("multi_query", True):
+        kv = 1
+    else:
+        kv = hf["num_attention_heads"]
+    hf2 = dict(hf)
+    hf2["intermediate_size"] = hf.get("ffn_hidden_size") or 4 * h
+    hf2["num_key_value_heads"] = kv
+    if hf.get("alibi"):
+        return ModelConfig(**_base_cfg(
+            hf2, rope=None, alibi=True, norm_kind="layer",
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5), act="gelu_new",
+            mlp_gated=False, parallel_blocks=hf.get("parallel_attn", True),
+            attention_bias=hf.get("bias", False),
+            attention_out_bias=hf.get("bias", False),
+            tie_word_embeddings=True,
+        ))
+    return ModelConfig(**_base_cfg(
+        hf2, norm_kind="layer",
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5), act="gelu_new",
+        mlp_gated=False, parallel_blocks=hf.get("parallel_attn", True),
+        attention_bias=hf.get("bias", False),
+        attention_out_bias=hf.get("bias", False),
+        tie_word_embeddings=True,
+    ))
 
 
 def _neox_qkv(w, cfg: ModelConfig):
@@ -500,6 +664,117 @@ _DEEPSEEK_V3_MOE = MoEScheme(
     shared_down="model.layers.{i}.mlp.shared_experts.down_proj.weight",
     score_bias="model.layers.{i}.mlp.gate.e_score_correction_bias",
 )
+def _falcon_qkv(w, cfg: ModelConfig):
+    """Falcon fused QKV: old-arch MHA interleaves per head (neox layout),
+    old-arch MQA is a straight [q...; k; v] concat, new-arch groups per kv
+    head (internlm2 layout)."""
+    if cfg.num_kv_heads == 1:
+        return w
+    if cfg.num_kv_heads == cfg.num_heads:
+        return _neox_qkv(w, cfg)
+    return _internlm2_qkv(w, cfg)
+
+
+_BLOOM_SCHEME = WeightScheme(
+    embed="transformer.word_embeddings.weight",
+    embed_norm="transformer.word_embeddings_layernorm.weight",
+    final_norm="transformer.ln_f.weight",
+    lm_head="transformer.word_embeddings.weight",
+    attn_norm="transformer.h.{i}.input_layernorm.weight",
+    mlp_norm="transformer.h.{i}.post_attention_layernorm.weight",
+    qkv="transformer.h.{i}.self_attention.query_key_value.{p}",
+    q=None, k=None, v=None,
+    o="transformer.h.{i}.self_attention.dense.{p}",
+    gate=None, gate_up=None,
+    up="transformer.h.{i}.mlp.dense_h_to_4h.{p}",
+    down="transformer.h.{i}.mlp.dense_4h_to_h.{p}",
+)
+_MPT_SCHEME = WeightScheme(
+    embed="transformer.wte.weight",
+    final_norm="transformer.norm_f.weight",
+    lm_head="transformer.wte.weight",
+    attn_norm="transformer.blocks.{i}.norm_1.weight",
+    mlp_norm="transformer.blocks.{i}.norm_2.weight",
+    qkv="transformer.blocks.{i}.attn.Wqkv.{p}",
+    q=None, k=None, v=None,
+    o="transformer.blocks.{i}.attn.out_proj.{p}",
+    gate=None, gate_up=None,
+    up="transformer.blocks.{i}.ffn.up_proj.{p}",
+    down="transformer.blocks.{i}.ffn.down_proj.{p}",
+)
+_GPT2_SCHEME = WeightScheme(
+    embed="transformer.wte.weight",
+    pos_embed="transformer.wpe.weight",
+    final_norm="transformer.ln_f.weight",
+    lm_head="transformer.wte.weight",
+    attn_norm="transformer.h.{i}.ln_1.weight",
+    mlp_norm="transformer.h.{i}.ln_2.weight",
+    qkv="transformer.h.{i}.attn.c_attn.{p}",
+    q=None, k=None, v=None,
+    o="transformer.h.{i}.attn.c_proj.{p}",
+    gate=None, gate_up=None,
+    up="transformer.h.{i}.mlp.c_fc.{p}",
+    down="transformer.h.{i}.mlp.c_proj.{p}",
+)
+_OPT_SCHEME = WeightScheme(
+    embed="model.decoder.embed_tokens.weight",
+    pos_embed="model.decoder.embed_positions.weight",
+    final_norm="model.decoder.final_layer_norm.weight",
+    lm_head="model.decoder.embed_tokens.weight",
+    attn_norm="model.decoder.layers.{i}.self_attn_layer_norm.weight",
+    mlp_norm="model.decoder.layers.{i}.final_layer_norm.weight",
+    q="model.decoder.layers.{i}.self_attn.q_proj.{p}",
+    k="model.decoder.layers.{i}.self_attn.k_proj.{p}",
+    v="model.decoder.layers.{i}.self_attn.v_proj.{p}",
+    o="model.decoder.layers.{i}.self_attn.out_proj.{p}",
+    gate=None, gate_up=None,
+    up="model.decoder.layers.{i}.fc1.{p}",
+    down="model.decoder.layers.{i}.fc2.{p}",
+)
+_GPTJ_SCHEME = WeightScheme(
+    embed="transformer.wte.weight",
+    final_norm="transformer.ln_f.weight",
+    lm_head="lm_head.weight",
+    attn_norm="transformer.h.{i}.ln_1.weight",
+    mlp_norm="transformer.h.{i}.ln_1.weight",  # ONE norm, parallel blocks
+    q="transformer.h.{i}.attn.q_proj.{p}",
+    k="transformer.h.{i}.attn.k_proj.{p}",
+    v="transformer.h.{i}.attn.v_proj.{p}",
+    o="transformer.h.{i}.attn.out_proj.{p}",
+    gate=None, gate_up=None,
+    up="transformer.h.{i}.mlp.fc_in.{p}",
+    down="transformer.h.{i}.mlp.fc_out.{p}",
+)
+_COHERE_SCHEME = WeightScheme(
+    lm_head="model.embed_tokens.weight",
+    mlp_norm="model.layers.{i}.input_layernorm.weight",  # ONE norm, parallel
+)
+_OLMO2_SCHEME = WeightScheme(
+    attn_norm="model.layers.{i}.post_attention_layernorm.weight",
+    mlp_norm="model.layers.{i}.post_feedforward_layernorm.weight",
+    q_norm="model.layers.{i}.self_attn.q_norm.weight",
+    k_norm="model.layers.{i}.self_attn.k_norm.weight",
+)
+_FALCON_SCHEME = WeightScheme(
+    embed="transformer.word_embeddings.weight",
+    final_norm="transformer.ln_f.weight",
+    lm_head="transformer.word_embeddings.weight",
+    # old arch: one shared input_layernorm; new arch: ln_attn / ln_mlp
+    attn_norm="transformer.h.{i}.input_layernorm.weight"
+              "|transformer.h.{i}.ln_attn.weight",
+    # non-parallel falcon-rw has a real post_attention_layernorm; try it
+    # first so it can never be shadowed by the always-present input norm
+    mlp_norm="transformer.h.{i}.post_attention_layernorm.weight"
+             "|transformer.h.{i}.ln_mlp.weight"
+             "|transformer.h.{i}.input_layernorm.weight",
+    qkv="transformer.h.{i}.self_attention.query_key_value.{p}",
+    q=None, k=None, v=None,
+    o="transformer.h.{i}.self_attention.dense.{p}",
+    gate=None, gate_up=None,
+    up="transformer.h.{i}.mlp.dense_h_to_4h.{p}",
+    down="transformer.h.{i}.mlp.dense_4h_to_h.{p}",
+)
+
 _MIXTRAL_MOE = MoEScheme(
     router="model.layers.{i}.block_sparse_moe.gate.weight",
     e_gate="model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
@@ -543,6 +818,16 @@ FAMILIES: dict[str, Family] = {
     "baichuan": Family("baichuan", _baichuan, _BAICHUAN_SCHEME),
     "internlm2": Family("internlm2", _internlm2, _INTERNLM2_SCHEME,
                         qkv_transform=_internlm2_qkv),
+    "bloom": Family("bloom", _bloom, _BLOOM_SCHEME, qkv_transform=_neox_qkv),
+    "mpt": Family("mpt", _mpt, _MPT_SCHEME),
+    "gpt2": Family("gpt2", _gpt2, _GPT2_SCHEME, transpose_weights=True),
+    "opt": Family("opt", _opt, _OPT_SCHEME),
+    "gptj": Family("gptj", _gptj, _GPTJ_SCHEME),
+    "cohere": Family("cohere", _cohere, _COHERE_SCHEME),
+    "stablelm": Family("stablelm", _stablelm),
+    "olmo2": Family("olmo2", _olmo2, _OLMO2_SCHEME),
+    "falcon": Family("falcon", _falcon, _FALCON_SCHEME,
+                     qkv_transform=_falcon_qkv),
     "glm": Family("glm", _glm, _GLM_SCHEME),
     "glm4": Family("glm4", _glm4, _GLM4_SCHEME),
     "chatglm": Family("chatglm", _chatglm, _CHATGLM_SCHEME),

@@ -73,6 +73,7 @@ def optimize_model(model: Any, low_bit: str = "sym_int4", **kwargs):
         cfg, family.scheme, get, has, qtype=low_bit,
         lm_head_qtype=lm_head_qtype, moe_scheme=family.moe,
         embedding_qtype=embedding_qtype, qkv_transform=family.qkv_transform,
+        transpose_weights=family.transpose_weights,
     )
     return TPUModelForCausalLM(cfg, params, hf_config, low_bit)
 

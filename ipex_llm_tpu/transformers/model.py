@@ -119,6 +119,7 @@ class TPUModelForCausalLM:
             qtype=qtype, mixed_precision=mixed_precision,
             moe_scheme=family.moe, embedding_qtype=embedding_qtype,
             qkv_transform=family.qkv_transform,
+            transpose_weights=family.transpose_weights,
         )
         model = cls(cfg, params, hf_config, qtype)
         if speculative:
@@ -132,6 +133,7 @@ class TPUModelForCausalLM:
                     cfg, family.scheme, reader.get, reader.has,
                     qtype="sym_int4", moe_scheme=family.moe,
                     qkv_transform=family.qkv_transform,
+                    transpose_weights=family.transpose_weights,
                 )
                 model.draft_model = cls(cfg, draft_params, hf_config, "sym_int4")
         if mesh is not None:

@@ -189,13 +189,16 @@ def test_internlm2_wqkv_layout(tmp_path, tiny_llama_sd):
     assert np.abs(got - want).max() / np.abs(want).max() < 0.06
 
 
-def test_baichuan_13b_alibi_rejected():
+def test_baichuan_13b_alibi_config():
+    """r2 rejected baichuan-13B; wave-4 ALiBi support admits it (full
+    ALiBi-math parity is covered by the bloom/mpt tests which share the
+    attention path)."""
     from ipex_llm_tpu.models.families import get_family
 
     fam = get_family("baichuan")
-    with pytest.raises(NotImplementedError):
-        fam.to_config({
-            "model_type": "baichuan", "vocab_size": 64000,
-            "hidden_size": 5120, "intermediate_size": 13696,
-            "num_hidden_layers": 40, "num_attention_heads": 40,
-        })
+    cfg = fam.to_config({
+        "model_type": "baichuan", "vocab_size": 64000,
+        "hidden_size": 5120, "intermediate_size": 13696,
+        "num_hidden_layers": 40, "num_attention_heads": 40,
+    })
+    assert cfg.alibi and cfg.rope is None

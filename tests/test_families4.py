@@ -1,0 +1,148 @@
+"""Model-family wave 4: logits parity vs HF torch for the classic
+architectures the reference patches (bloom/falcon/mpt with ALiBi, gpt2/opt
+learned positions, gptj parallel blocks, cohere, stablelm, olmo2).
+
+New decoder capabilities under test: ALiBi biases, learned absolute
+position embeddings, bloom's embedding layernorm, olmo2 reordered norms +
+flat qk-norm, Conv1D-transposed checkpoints, falcon fused-QKV layouts.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOKENS = np.random.default_rng(11).integers(0, 150, (2, 10)).astype(np.int32)
+
+
+def _check(tmp_path, hf_model, name, tol=0.06, agree=0.85):
+    path = str(tmp_path / name)
+    hf_model.save_pretrained(path, safe_serialization=True)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    got = np.asarray(model(TOKENS))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < tol, np.abs(got - want).max() / scale
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > agree
+    return model
+
+
+def test_bloom_alibi_logits(tmp_path):
+    from transformers import BloomConfig, BloomForCausalLM
+
+    cfg = BloomConfig(vocab_size=150, hidden_size=64, n_layer=2, n_head=4,
+                      layer_norm_epsilon=1e-5)
+    torch.manual_seed(0)
+    _check(tmp_path, BloomForCausalLM(cfg).eval(), "bloom")
+
+
+def test_mpt_alibi_logits(tmp_path):
+    from transformers import MptConfig, MptForCausalLM
+
+    cfg = MptConfig(d_model=64, n_heads=4, n_layers=2, expansion_ratio=2,
+                    max_seq_len=256, vocab_size=150)
+    torch.manual_seed(1)
+    _check(tmp_path, MptForCausalLM(cfg).eval(), "mpt")
+
+
+def test_gpt2_logits(tmp_path):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config(vocab_size=150, n_embd=64, n_layer=2, n_head=4,
+                     n_positions=256)
+    torch.manual_seed(2)
+    _check(tmp_path, GPT2LMHeadModel(cfg).eval(), "gpt2")
+
+
+def test_opt_logits(tmp_path):
+    from transformers import OPTConfig, OPTForCausalLM
+
+    cfg = OPTConfig(vocab_size=150, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, ffn_dim=128,
+                    max_position_embeddings=256, word_embed_proj_dim=64,
+                    pad_token_id=0)
+    torch.manual_seed(3)
+    _check(tmp_path, OPTForCausalLM(cfg).eval(), "opt")
+
+
+def test_gptj_logits(tmp_path):
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    cfg = GPTJConfig(vocab_size=150, n_embd=64, n_layer=2, n_head=4,
+                     rotary_dim=8, n_positions=256)
+    torch.manual_seed(4)
+    _check(tmp_path, GPTJForCausalLM(cfg).eval(), "gptj")
+
+
+def test_cohere_logits(tmp_path):
+    from transformers import CohereConfig, CohereForCausalLM
+
+    cfg = CohereConfig(vocab_size=150, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, logit_scale=0.5,
+                       max_position_embeddings=256, use_qk_norm=False,
+                       pad_token_id=0)
+    torch.manual_seed(5)
+    _check(tmp_path, CohereForCausalLM(cfg).eval(), "cohere")
+
+
+def test_stablelm_logits(tmp_path):
+    from transformers import StableLmConfig, StableLmForCausalLM
+
+    cfg = StableLmConfig(vocab_size=150, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         partial_rotary_factor=0.25, use_qkv_bias=True,
+                         max_position_embeddings=256)
+    torch.manual_seed(6)
+    _check(tmp_path, StableLmForCausalLM(cfg).eval(), "stablelm")
+
+
+def test_olmo2_logits(tmp_path):
+    from transformers import Olmo2Config, Olmo2ForCausalLM
+
+    cfg = Olmo2Config(vocab_size=150, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256)
+    torch.manual_seed(7)
+    _check(tmp_path, Olmo2ForCausalLM(cfg).eval(), "olmo2")
+
+
+def test_falcon_7b_style_logits(tmp_path):
+    """Old architecture: MQA fused qkv, parallel attn, single norm."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    cfg = FalconConfig(vocab_size=150, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, multi_query=True,
+                       parallel_attn=True, new_decoder_architecture=False,
+                       bias=False, alibi=False)
+    torch.manual_seed(8)
+    _check(tmp_path, FalconForCausalLM(cfg).eval(), "falcon7b")
+
+
+def test_falcon_new_arch_logits(tmp_path):
+    """New architecture: grouped fused qkv (kv groups)."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    cfg = FalconConfig(vocab_size=150, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_kv_heads=2,
+                       multi_query=False, parallel_attn=True,
+                       new_decoder_architecture=True, bias=False, alibi=False)
+    torch.manual_seed(9)
+    _check(tmp_path, FalconForCausalLM(cfg).eval(), "falconnew")
+
+
+def test_baichuan_13b_alibi_accepted():
+    """The r2 guard raised on baichuan-13B; ALiBi support admits it now."""
+    from ipex_llm_tpu.models.families import get_family
+
+    cfg = get_family("baichuan").to_config({
+        "model_type": "baichuan", "vocab_size": 64000,
+        "hidden_size": 5120, "intermediate_size": 13696,
+        "num_hidden_layers": 40, "num_attention_heads": 40,
+    })
+    assert cfg.alibi and cfg.rope is None
