@@ -41,6 +41,7 @@ class ModelConfig:
     rope: RopeScaling | None = None
     rope_layout: str = "half"     # half | two
     partial_rotary: float = 1.0
+    mrope_section: tuple[int, ...] | None = None  # qwen2-vl 3-channel rope
 
     # projections
     attention_bias: bool = False

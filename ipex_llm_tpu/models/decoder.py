@@ -311,6 +311,7 @@ def decoder_forward(
     last_token_only: bool = False,
     collect_obs: int = 0,
     slot_offsets: jnp.ndarray | None = None,  # [B] per-row cache write slots
+    input_embeds: jnp.ndarray | None = None,  # [B, T, H] bypasses the lookup
 ):
     """Run the decoder; returns (logits, updated cache).
 
@@ -328,7 +329,11 @@ def decoder_forward(
 
     b, t = tokens.shape
     embed = params["embed"]
-    x = embed_lookup(embed, tokens, COMPUTE_DTYPE)
+    if input_embeds is not None:
+        # multimodal path: image features already spliced into the stream
+        x = input_embeds.astype(COMPUTE_DTYPE)
+    else:
+        x = embed_lookup(embed, tokens, COMPUTE_DTYPE)
     if cfg.embedding_multiplier != 1.0:
         x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
     if cfg.learned_pos:
@@ -348,9 +353,20 @@ def decoder_forward(
                 jax.lax.stop_gradient(v)
             )
 
-        cos, sin = rope_ops.cos_sin(
-            rope_positions, frozen("inv_freq"), frozen("rope_mscale", 1.0)
-        )
+        if cfg.mrope_section is not None:
+            # qwen2-vl M-ROPE: [B,3,T] t/h/w channels ([B,T] text-only input
+            # broadcasts to equal channels, reducing to plain rope)
+            mpos = rope_positions
+            if mpos.ndim == 2:
+                mpos = jnp.broadcast_to(mpos[:, None, :],
+                                        (b, 3, mpos.shape[1]))
+            cos, sin = rope_ops.cos_sin_mrope(
+                mpos, frozen("inv_freq"), cfg.mrope_section
+            )
+        else:
+            cos, sin = rope_ops.cos_sin(
+                rope_positions, frozen("inv_freq"), frozen("rope_mscale", 1.0)
+            )
 
     alibi_bias = None
 

@@ -1,0 +1,201 @@
+"""Qwen2-VL vision tower (ViT with 2D rope + spatial patch merger).
+
+Reference counterpart: the qwen2_vl patches (reference
+transformers/models/qwen2_vl.py — vision SDPA + merged-qkv rewrites over
+HF's Qwen2VisionTransformerPretrainedModel).  TPU-first shape choices:
+
+- the Conv3d patch projection IS a matmul (stride == kernel), so patches
+  arrive as the HF processor's flattened ``[n_patches, C*tps*ps*ps]`` rows
+  and go straight onto the MXU — no conv op at all;
+- one image = one attention segment: full (non-causal) attention over the
+  patch sequence in a single fused SDPA call; multi-image inputs run per
+  image through the same jitted forward (static shape per grid bucket);
+- big projections (qkv/proj/fc1/fc2/merger) quantize like decoder weights;
+  norms stay fp32.
+
+The tower output feeds decoder_forward(input_embeds=...) where image rows
+replace ``image_token_id`` slots (models/multimodal glue in
+transformers/multimodal.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    depth: int
+    embed_dim: int
+    num_heads: int
+    hidden_size: int            # text hidden size (merger output)
+    mlp_ratio: float = 4.0
+    in_channels: int = 3
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    act: str = "quick_gelu"
+    norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @classmethod
+    def from_hf(cls, v: dict, text_hidden: int) -> "VisionConfig":
+        return cls(
+            depth=v["depth"], embed_dim=v["embed_dim"],
+            num_heads=v["num_heads"],
+            hidden_size=v.get("hidden_size", text_hidden),
+            mlp_ratio=v.get("mlp_ratio", 4.0),
+            in_channels=v.get("in_channels", 3),
+            patch_size=v.get("patch_size", 14),
+            temporal_patch_size=v.get("temporal_patch_size", 2),
+            spatial_merge_size=v.get("spatial_merge_size", 2),
+            act=v.get("hidden_act", "quick_gelu"),
+        )
+
+
+def build_vision_params(vc: VisionConfig, get: Callable, has: Callable,
+                        qtype: str, prefix_candidates=("visual.",
+                                                       "model.visual.")):
+    """Assemble the tower pytree (quantizing projections)."""
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    prefix = None
+    for p in prefix_candidates:
+        if has(p + "patch_embed.proj.weight"):
+            prefix = p
+            break
+    if prefix is None:
+        raise ValueError("no vision tower weights found in checkpoint")
+
+    def g(n):
+        return get(prefix + n)
+
+    def gb(lp, key, n):
+        if has(prefix + n):
+            lp[key] = jnp.asarray(g(n), jnp.float32)
+
+    params: dict[str, Any] = {}
+    pw = g("patch_embed.proj.weight")           # [E, C, tps, ps, ps]
+    params["patch_proj"] = quantize_weight(
+        np.ascontiguousarray(pw.reshape(pw.shape[0], -1)), qtype
+    )
+    layers = []
+    for i in range(vc.depth):
+        lp: dict[str, Any] = {}
+        b = f"blocks.{i}."
+        lp["norm1"] = jnp.asarray(g(b + "norm1.weight"), jnp.float32)
+        gb(lp, "norm1_bias", b + "norm1.bias")
+        lp["norm2"] = jnp.asarray(g(b + "norm2.weight"), jnp.float32)
+        gb(lp, "norm2_bias", b + "norm2.bias")
+        lp["qkv"] = quantize_weight(g(b + "attn.qkv.weight"), qtype)
+        lp["qkv_bias"] = jnp.asarray(g(b + "attn.qkv.bias"), jnp.float32)
+        lp["proj"] = quantize_weight(g(b + "attn.proj.weight"), qtype)
+        gb(lp, "proj_bias", b + "attn.proj.bias")
+        lp["fc1"] = quantize_weight(g(b + "mlp.fc1.weight"), qtype)
+        gb(lp, "fc1_bias", b + "mlp.fc1.bias")
+        lp["fc2"] = quantize_weight(g(b + "mlp.fc2.weight"), qtype)
+        gb(lp, "fc2_bias", b + "mlp.fc2.bias")
+        layers.append(lp)
+    params["blocks"] = stack_layer_trees(layers)
+    params["merger_ln"] = jnp.asarray(g("merger.ln_q.weight"), jnp.float32)
+    params["merger_ln_bias"] = jnp.asarray(g("merger.ln_q.bias"), jnp.float32)
+    params["merger_fc1"] = quantize_weight(g("merger.mlp.0.weight"), qtype)
+    params["merger_fc1_bias"] = jnp.asarray(g("merger.mlp.0.bias"), jnp.float32)
+    params["merger_fc2"] = quantize_weight(g("merger.mlp.2.weight"), qtype)
+    params["merger_fc2_bias"] = jnp.asarray(g("merger.mlp.2.bias"), jnp.float32)
+    return params
+
+
+def vision_rotary(vc: VisionConfig, grid_thw: tuple[int, int, int]) -> np.ndarray:
+    """Per-patch 2D rope angles [n_patches, head_dim/2] (h and w halves),
+    ordered by the spatial-merge permutation (HF rot_pos_emb)."""
+    t, h, w = grid_thw
+    m = vc.spatial_merge_size
+    hpos = np.arange(h)[:, None].repeat(w, 1)
+    wpos = np.arange(w)[None, :].repeat(h, 0)
+
+    def merge_perm(x):
+        return x.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+
+    hp, wp = merge_perm(hpos), merge_perm(wpos)
+    hp = np.tile(hp, t)
+    wp = np.tile(wp, t)
+    dim = vc.head_dim // 4
+    inv = 1.0 / (10000.0 ** (np.arange(0, dim * 2, 2, dtype=np.float64) / (dim * 2)))
+    freqs = np.concatenate(
+        [hp[:, None] * inv[None, :], wp[:, None] * inv[None, :]], axis=1
+    )
+    return freqs.astype(np.float32)              # [N, head_dim/2]
+
+
+def _rotate_half(x):
+    d2 = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+
+
+@partial(jax.jit, static_argnames=("vc",))
+def vision_forward(vc: VisionConfig, params: dict, pixels: jnp.ndarray,
+                   freqs: jnp.ndarray) -> jnp.ndarray:
+    """pixels [N, C*tps*ps*ps] flattened patches; freqs [N, head_dim/2].
+
+    Returns merged image embeddings [N / merge^2, hidden_size].
+    """
+    x = linear_ops.linear(
+        pixels.astype(jnp.bfloat16)[None], params["patch_proj"]
+    )[0]                                          # [N, E]
+    n = x.shape[0]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)   # [N, head_dim]
+    cos = jnp.cos(emb)[None, :, None, :]
+    sin = jnp.sin(emb)[None, :, None, :]
+
+    def block(x, lp):
+        h = layer_norm(x, lp["norm1"], lp.get("norm1_bias"), vc.norm_eps)
+        qkv = linear_ops.linear(h[None], lp["qkv"], lp["qkv_bias"])[0]
+        q, k, v = jnp.split(
+            qkv.reshape(n, 3, vc.num_heads, vc.head_dim), 3, axis=1
+        )
+        q, k, v = (y[:, 0][None] for y in (q, k, v))  # [1, N, H, D]
+        qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = (qf * cos + _rotate_half(qf) * sin).astype(q.dtype)
+        k = (kf * cos + _rotate_half(kf) * sin).astype(k.dtype)
+        from ipex_llm_tpu.ops.attention import sdpa
+
+        attn = sdpa(q, k, v, causal=False)        # full attention, one image
+        attn = attn.reshape(1, n, vc.embed_dim)
+        o = linear_ops.linear(attn, lp["proj"], lp.get("proj_bias"))[0]
+        x = x + o
+        h2 = layer_norm(x, lp["norm2"], lp.get("norm2_bias"), vc.norm_eps)
+        inner = mlp_ops.act(
+            linear_ops.linear(h2[None], lp["fc1"], lp.get("fc1_bias")),
+            vc.act,
+        )
+        x = x + linear_ops.linear(inner, lp["fc2"], lp.get("fc2_bias"))[0]
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+
+    # spatial merger: ln then group merge^2 neighbors -> 2-layer MLP
+    x = layer_norm(x, params["merger_ln"], params["merger_ln_bias"],
+                   vc.norm_eps)
+    gsz = vc.spatial_merge_size ** 2
+    x = x.reshape(n // gsz, gsz * vc.embed_dim)
+    x = mlp_ops.act(
+        linear_ops.linear(x[None], params["merger_fc1"],
+                          params["merger_fc1_bias"]),
+        "gelu",
+    )
+    x = linear_ops.linear(x, params["merger_fc2"], params["merger_fc2_bias"])
+    return x[0]                                   # [N/gsz, hidden]

@@ -1,0 +1,326 @@
+"""Whisper speech-to-text (encoder-decoder) — AutoModelForSpeechSeq2Seq.
+
+Reference counterpart: transformers/models/whisper.py (the reference
+patches HF Whisper's attention to its fused SDPA).  Whisper's shape is an
+encoder-decoder with cross-attention, structurally different from the
+shared causal decoder (models/decoder.py), so it gets a compact dedicated
+module built on the same op library: quantized projections
+(ops/linear), fused SDPA (ops/attention.sdpa), layer norms.
+
+TPU-first choices:
+- mel conv stem runs as ``lax.conv_general_dilated`` (maps to MXU);
+- encoder runs once per utterance as a single jitted call, cross-attention
+  K/V for every decoder layer are precomputed from the encoder output
+  (one batched matmul each) and stay static through decoding;
+- the decoder's self-attention KV cache is the same static-ring
+  ``kv.KVCache``; decode steps are a jitted single-token forward.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class WhisperConfig:
+    vocab_size: int
+    d_model: int
+    encoder_layers: int
+    encoder_heads: int
+    decoder_layers: int
+    decoder_heads: int
+    encoder_ffn: int
+    decoder_ffn: int
+    num_mel_bins: int
+    max_source_positions: int
+    max_target_positions: int
+    decoder_start_token_id: int
+    eos_token_id: int
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.decoder_heads
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "WhisperConfig":
+        return cls(
+            vocab_size=hf["vocab_size"], d_model=hf["d_model"],
+            encoder_layers=hf["encoder_layers"],
+            encoder_heads=hf["encoder_attention_heads"],
+            decoder_layers=hf["decoder_layers"],
+            decoder_heads=hf["decoder_attention_heads"],
+            encoder_ffn=hf["encoder_ffn_dim"], decoder_ffn=hf["decoder_ffn_dim"],
+            num_mel_bins=hf["num_mel_bins"],
+            max_source_positions=hf["max_source_positions"],
+            max_target_positions=hf["max_target_positions"],
+            decoder_start_token_id=hf.get("decoder_start_token_id", 50258),
+            eos_token_id=hf.get("eos_token_id", 50257),
+        )
+
+
+def _attn_params(get, has, base: str, qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight
+
+    lp = {}
+    for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+        lp[proj] = quantize_weight(get(f"{base}.{proj}.weight"), qtype)
+        if has(f"{base}.{proj}.bias"):
+            lp[proj + "_bias"] = jnp.asarray(get(f"{base}.{proj}.bias"),
+                                             jnp.float32)
+    return lp
+
+
+def _ln(get, has, name: str) -> dict:
+    out = {"w": jnp.asarray(get(name + ".weight"), jnp.float32)}
+    if has(name + ".bias"):
+        out["b"] = jnp.asarray(get(name + ".bias"), jnp.float32)
+    return out
+
+
+def build_whisper_params(cfg: WhisperConfig, get, has, qtype: str) -> dict:
+    """Assemble encoder+decoder pytrees from an HF whisper checkpoint."""
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    p: dict[str, Any] = {}
+    p["conv1_w"] = jnp.asarray(get("model.encoder.conv1.weight"), jnp.bfloat16)
+    p["conv1_b"] = jnp.asarray(get("model.encoder.conv1.bias"), jnp.float32)
+    p["conv2_w"] = jnp.asarray(get("model.encoder.conv2.weight"), jnp.bfloat16)
+    p["conv2_b"] = jnp.asarray(get("model.encoder.conv2.bias"), jnp.float32)
+    p["enc_pos"] = jnp.asarray(get("model.encoder.embed_positions.weight"),
+                               jnp.bfloat16)
+    enc_layers = []
+    for i in range(cfg.encoder_layers):
+        b = f"model.encoder.layers.{i}"
+        lp = {"attn": _attn_params(get, has, b + ".self_attn", qtype)}
+        lp["ln1"] = _ln(get, has, b + ".self_attn_layer_norm")
+        lp["ln2"] = _ln(get, has, b + ".final_layer_norm")
+        lp["fc1"] = quantize_weight(get(b + ".fc1.weight"), qtype)
+        lp["fc1_b"] = jnp.asarray(get(b + ".fc1.bias"), jnp.float32)
+        lp["fc2"] = quantize_weight(get(b + ".fc2.weight"), qtype)
+        lp["fc2_b"] = jnp.asarray(get(b + ".fc2.bias"), jnp.float32)
+        enc_layers.append(lp)
+    p["enc_layers"] = stack_layer_trees(enc_layers)
+    p["enc_ln"] = _ln(get, has, "model.encoder.layer_norm")
+
+    p["embed"] = jnp.asarray(get("model.decoder.embed_tokens.weight"),
+                             jnp.bfloat16)
+    p["dec_pos"] = jnp.asarray(get("model.decoder.embed_positions.weight"),
+                               jnp.bfloat16)
+    dec_layers = []
+    for i in range(cfg.decoder_layers):
+        b = f"model.decoder.layers.{i}"
+        lp = {
+            "attn": _attn_params(get, has, b + ".self_attn", qtype),
+            "xattn": _attn_params(get, has, b + ".encoder_attn", qtype),
+        }
+        lp["ln1"] = _ln(get, has, b + ".self_attn_layer_norm")
+        lp["lnx"] = _ln(get, has, b + ".encoder_attn_layer_norm")
+        lp["ln2"] = _ln(get, has, b + ".final_layer_norm")
+        lp["fc1"] = quantize_weight(get(b + ".fc1.weight"), qtype)
+        lp["fc1_b"] = jnp.asarray(get(b + ".fc1.bias"), jnp.float32)
+        lp["fc2"] = quantize_weight(get(b + ".fc2.weight"), qtype)
+        lp["fc2_b"] = jnp.asarray(get(b + ".fc2.bias"), jnp.float32)
+        dec_layers.append(lp)
+    p["dec_layers"] = stack_layer_trees(dec_layers)
+    p["dec_ln"] = _ln(get, has, "model.decoder.layer_norm")
+    return p
+
+
+def _lnorm(x, ln):
+    return layer_norm(x, ln["w"], ln.get("b"), 1e-5)
+
+
+def _mha(lp, hq, kv_src, n_heads, causal, kv_len=None):
+    """Generic MHA: q from hq, k/v from kv_src (self or cross)."""
+    b, t, d = hq.shape
+    hd = d // n_heads
+    q = linear_ops.linear(hq, lp["q_proj"], lp.get("q_proj_bias"))
+    k = linear_ops.linear(kv_src, lp["k_proj"], lp.get("k_proj_bias"))
+    v = linear_ops.linear(kv_src, lp["v_proj"], lp.get("v_proj_bias"))
+    q = q.reshape(b, t, n_heads, hd)
+    k = k.reshape(b, kv_src.shape[1], n_heads, hd)
+    v = v.reshape(b, kv_src.shape[1], n_heads, hd)
+    o = sdpa_reference(q, k, v, causal=causal, kv_len=kv_len)
+    o = o.reshape(b, t, d)
+    return linear_ops.linear(o, lp["out_proj"], lp.get("out_proj_bias"))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode(cfg: WhisperConfig, params: dict, feats: jnp.ndarray) -> jnp.ndarray:
+    """input_features [B, mels, T_frames] -> encoder states [B, T', d]."""
+    dn = ("NCH", "OIH", "NCH")
+    x = jax.lax.conv_general_dilated(
+        feats.astype(jnp.bfloat16), params["conv1_w"], (1,), [(1, 1)],
+        dimension_numbers=dn,
+    ) + params["conv1_b"][None, :, None].astype(jnp.bfloat16)
+    x = jax.nn.gelu(x.astype(jnp.float32), approximate=False)
+    x = jax.lax.conv_general_dilated(
+        x.astype(jnp.bfloat16), params["conv2_w"], (2,), [(1, 1)],
+        dimension_numbers=dn,
+    ) + params["conv2_b"][None, :, None].astype(jnp.bfloat16)
+    x = jax.nn.gelu(x.astype(jnp.float32), approximate=False)
+    x = x.transpose(0, 2, 1).astype(jnp.bfloat16)            # [B, T', d]
+    x = x + params["enc_pos"][: x.shape[1]][None]
+
+    def block(x, lp):
+        h = _lnorm(x, lp["ln1"])
+        x = x + _mha(lp["attn"], h, h, cfg.encoder_heads, causal=False)
+        h = _lnorm(x, lp["ln2"])
+        inner = jax.nn.gelu(
+            linear_ops.linear(h, lp["fc1"], lp["fc1_b"]).astype(jnp.float32),
+            approximate=False,
+        ).astype(jnp.bfloat16)
+        x = x + linear_ops.linear(inner, lp["fc2"], lp["fc2_b"])
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["enc_layers"])
+    return _lnorm(x, params["enc_ln"])
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(cfg: WhisperConfig, params: dict, enc: jnp.ndarray,
+                tokens: jnp.ndarray, cache: KVCache, pos0: jnp.ndarray):
+    """Run T decoder tokens at positions pos0..pos0+T-1.
+
+    Returns (logits [B, T, V], updated cache)."""
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(jnp.bfloat16)
+    x = x + params["dec_pos"][pos0 + jnp.arange(t)][None]
+    n_h = cfg.decoder_heads
+    hd = cfg.head_dim
+    kv_len = jnp.broadcast_to(pos0 + t, (b,))
+
+    def block(carry, xs):
+        x = carry
+        lp, kl, vl = xs
+        h = _lnorm(x, lp["ln1"])
+        q = linear_ops.linear(h, lp["attn"]["q_proj"],
+                              lp["attn"].get("q_proj_bias"))
+        k = linear_ops.linear(h, lp["attn"]["k_proj"],
+                              lp["attn"].get("k_proj_bias"))
+        v = linear_ops.linear(h, lp["attn"]["v_proj"],
+                              lp["attn"].get("v_proj_bias"))
+        k4 = k.reshape(b, t, n_h, hd)
+        v4 = v.reshape(b, t, n_h, hd)
+        kl, vl = cache.update_layer(kl, vl, k4, v4, pos0)
+        kd = kl.astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        vd = vl.astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        qpos = pos0 + jnp.arange(t)[None, :]
+        o = sdpa_reference(
+            q.reshape(b, t, n_h, hd), kd, vd, causal=True,
+            q_positions=jnp.broadcast_to(qpos, (b, t)), kv_len=kv_len,
+        ).reshape(b, t, cfg.d_model)
+        x = x + linear_ops.linear(o, lp["attn"]["out_proj"],
+                                  lp["attn"].get("out_proj_bias"))
+        # cross attention over the (static) encoder states
+        h = _lnorm(x, lp["lnx"])
+        x = x + _mha(lp["xattn"], h, enc, n_h, causal=False)
+        h = _lnorm(x, lp["ln2"])
+        inner = jax.nn.gelu(
+            linear_ops.linear(h, lp["fc1"], lp["fc1_b"]).astype(jnp.float32),
+            approximate=False,
+        ).astype(jnp.bfloat16)
+        x = x + linear_ops.linear(inner, lp["fc2"], lp["fc2_b"])
+        return x, (kl, vl)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["dec_layers"], cache.k, cache.v)
+    )
+    x = _lnorm(x, params["dec_ln"])
+    logits = jnp.matmul(
+        x, params["embed"].T.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    from dataclasses import replace as _replace
+
+    return logits.astype(jnp.float32), _replace(cache, k=k_new, v=v_new)
+
+
+class TPUWhisperForConditionalGeneration:
+    """AutoModelForSpeechSeq2Seq drop-in for whisper checkpoints."""
+
+    def __init__(self, cfg: WhisperConfig, params: dict, hf_config: dict,
+                 qtype: str):
+        self.config = cfg
+        self.params = params
+        self.hf_config = hf_config
+        self.qtype = qtype
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.loader import CheckpointReader, read_config
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf = read_config(path)
+        if hf.get("model_type") != "whisper":
+            raise ValueError(
+                f"AutoModelForSpeechSeq2Seq supports whisper; got "
+                f"{hf.get('model_type')!r}"
+            )
+        cfg = WhisperConfig.from_hf(hf)
+        reader = CheckpointReader(path)
+        params = build_whisper_params(cfg, reader.get, reader.has, qtype)
+        return cls(cfg, params, hf, qtype)
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(path, self.params, self.hf_config, self.qtype)
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+
+        params, hf, qtype = serialize.load_low_bit(path)
+        return cls(WhisperConfig.from_hf(hf), params, hf, qtype)
+
+    def generate(self, input_features, max_new_tokens: int = 64,
+                 forced_decoder_ids=None, **kwargs):
+        """Greedy transcription; returns token ids [1, T]."""
+        cfg = self.config
+        feats = jnp.asarray(np.asarray(input_features, np.float32))
+        if feats.ndim == 2:
+            feats = feats[None]
+        enc = encode(cfg, self.params, feats)
+
+        start = [cfg.decoder_start_token_id]
+        if forced_decoder_ids:
+            start += [t for _, t in sorted(forced_decoder_ids)]
+        # the learned position table ends at max_target_positions: decoding
+        # past it would clamp-overwrite the last cache slot (HF stops at
+        # max_length), so bound the budget the same way
+        max_new_tokens = min(max_new_tokens,
+                             cfg.max_target_positions - len(start) - 1)
+        cache = KVCache.init(
+            cfg.decoder_layers, feats.shape[0],
+            min(cfg.max_target_positions, len(start) + max_new_tokens + 1),
+            cfg.decoder_heads, cfg.head_dim,
+        )
+        toks = jnp.asarray([start], jnp.int32)
+        logits, cache = decode_step(cfg, self.params, enc, toks, cache,
+                                    jnp.asarray(0, jnp.int32))
+        out = list(start)
+        tok = int(jnp.argmax(logits[0, -1]))
+        for step in range(max_new_tokens):
+            out.append(tok)
+            if tok == cfg.eos_token_id:
+                break
+            logits, cache = decode_step(
+                cfg, self.params, enc, jnp.asarray([[tok]], jnp.int32),
+                cache, jnp.asarray(len(out) - 1, jnp.int32),
+            )
+            tok = int(jnp.argmax(logits[0, -1]))
+        return np.asarray(out, np.int32)[None]

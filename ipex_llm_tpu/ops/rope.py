@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -101,6 +102,25 @@ def cos_sin(positions: jnp.ndarray, inv_freq: jnp.ndarray, mscale: float = 1.0):
     """positions [..., T] int -> (cos, sin) each [..., T, rotary_dim/2] fp32."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq
     return jnp.cos(angles) * mscale, jnp.sin(angles) * mscale
+
+
+def cos_sin_mrope(positions: jnp.ndarray, inv_freq: jnp.ndarray,
+                  section: tuple[int, ...]):
+    """Qwen2-VL multimodal rope (reference qwen2_vl.py M-ROPE patches).
+
+    positions [B, 3, T]: temporal/height/width position channels.  Each
+    frequency index is assigned to one channel by ``mrope_section`` (e.g.
+    (16, 24, 24) over 64 freqs); text tokens carry equal channels so the
+    result reduces to plain rope.
+    Returns (cos, sin) each [B, T, rd/2].
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,3,T,F]
+    idx = jnp.concatenate([
+        jnp.full((s,), c, jnp.int32) for c, s in enumerate(section)
+    ])                                                            # [F]
+    sel = jax.nn.one_hot(idx, 3, dtype=jnp.float32)               # [F,3]
+    merged = jnp.einsum("bctf,fc->btf", angles, sel)
+    return jnp.cos(merged), jnp.sin(merged)
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,

@@ -11,11 +11,17 @@ from ipex_llm_tpu.transformers.model import (
     AutoModelForSpeechSeq2Seq,
     TPUModelForCausalLM,
 )
+from ipex_llm_tpu.transformers.multimodal import (
+    AutoModelForVision2Seq,
+    TPUModelForVision2Seq,
+)
 
 __all__ = [
     "AutoModel",
     "AutoModelForCausalLM",
     "AutoModelForSeq2SeqLM",
     "AutoModelForSpeechSeq2Seq",
+    "AutoModelForVision2Seq",
     "TPUModelForCausalLM",
+    "TPUModelForVision2Seq",
 ]

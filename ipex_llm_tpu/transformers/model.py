@@ -373,8 +373,18 @@ class _NotYetSupported:
     load_low_bit = from_pretrained
 
 
-class AutoModelForSpeechSeq2Seq(_NotYetSupported):
-    pass
+class AutoModelForSpeechSeq2Seq:
+    """Speech seq2seq loader (whisper; reference model.py:803)."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, *args, **kwargs):
+        from ipex_llm_tpu.models.whisper import (
+            TPUWhisperForConditionalGeneration,
+        )
+
+        return TPUWhisperForConditionalGeneration.from_pretrained(
+            str(path), **kwargs
+        )
 
 
 class AutoModelForSeq2SeqLM(_NotYetSupported):

@@ -1,0 +1,269 @@
+"""Qwen2-VL multimodal causal LM (vision-language drop-in).
+
+Reference counterpart: transformers/models/qwen2_vl.py — the reference
+patches HF's Qwen2VLForConditionalGeneration (merged qkv, SDPA, M-ROPE
+kept intact).  Here the HF checkpoint is a weight source: the vision tower
+(models/vision.py) produces image embeddings that replace the
+``image_token_id`` slots, and the shared decoder runs with
+``input_embeds`` + 3-channel M-ROPE positions.
+
+Naming tolerates both checkpoint layouts: legacy ``visual.* / model.*`` and
+the 4.52+ nested ``model.visual.* / model.language_model.*``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.models.build import build_params
+from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.models.families import WeightScheme, _base_cfg
+from ipex_llm_tpu.models.loader import CheckpointReader, read_config
+from ipex_llm_tpu.models.vision import (
+    VisionConfig,
+    build_vision_params,
+    vision_forward,
+    vision_rotary,
+)
+
+
+def _qwen2_vl_text_config(hf: dict) -> ModelConfig:
+    text = dict(hf.get("text_config") or hf)
+    text.setdefault("model_type", "qwen2_vl")
+    rs = text.get("rope_scaling") or hf.get("rope_scaling") or {}
+    section = rs.get("mrope_section")
+    d = _base_cfg(
+        text,
+        attention_bias=True,
+        attention_out_bias=False,
+        mrope_section=tuple(section) if section else None,
+    )
+    # mrope's rope table is plain default frequencies; the section logic
+    # lives in ops/rope.cos_sin_mrope
+    d["rope"] = d["rope"].__class__(
+        head_dim=d["head_dim"], base=text.get("rope_theta", 10000.0)
+    )
+    return ModelConfig(**d)
+
+
+class _AliasReader:
+    """Try canonical then nested (model.language_model.) weight names."""
+
+    def __init__(self, reader):
+        self.reader = reader
+
+    def _resolve(self, name: str) -> str:
+        if self.reader.has(name):
+            return name
+        if name.startswith("model."):
+            alt = "model.language_model." + name[len("model."):]
+            if self.reader.has(alt):
+                return alt
+        if name == "lm_head.weight":
+            for alt in ("model.lm_head.weight",):
+                if self.reader.has(alt):
+                    return alt
+        return name
+
+    def get(self, name: str):
+        return self.reader.get(self._resolve(name))
+
+    def has(self, name: str) -> bool:
+        return self.reader.has(self._resolve(name))
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _mm_prefill(cfg, params, cache, tokens, pos, embeds):
+    from ipex_llm_tpu.models.decoder import decoder_forward
+
+    return decoder_forward(cfg, params, tokens, cache, pos,
+                           input_embeds=embeds, last_token_only=True)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _mm_decode(cfg, params, cache, tok, pos):
+    from ipex_llm_tpu.models.decoder import decoder_forward
+
+    return decoder_forward(cfg, params, tok, cache, pos)
+
+
+class TPUModelForVision2Seq:
+    """Qwen2-VL-style conditional generation (image + text -> text)."""
+
+    def __init__(self, cfg: ModelConfig, vcfg: VisionConfig, params: dict,
+                 vparams: dict, hf_config: dict, qtype: str):
+        self.config = cfg
+        self.vision_config = vcfg
+        self.params = params
+        self.vision_params = vparams
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.image_token_id = hf_config.get("image_token_id", 151655)
+        self.vision_start_token_id = hf_config.get("vision_start_token_id",
+                                                   151652)
+        self.spatial_merge = vcfg.spatial_merge_size
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        if hf_config.get("model_type") not in ("qwen2_vl",):
+            raise ValueError(
+                f"AutoModelForVision2Seq supports qwen2_vl checkpoints; got "
+                f"{hf_config.get('model_type')!r}"
+            )
+        cfg = _qwen2_vl_text_config(hf_config)
+        vcfg = VisionConfig.from_hf(hf_config["vision_config"],
+                                    text_hidden=cfg.hidden_size)
+        reader = _AliasReader(CheckpointReader(path))
+        params = build_params(cfg, WeightScheme(), reader.get, reader.has,
+                              qtype=qtype)
+        vparams = build_vision_params(vcfg, reader.reader.get,
+                                      reader.reader.has, qtype)
+        return cls(cfg, vcfg, params, vparams, hf_config, qtype)
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(
+            path, {"text": self.params, "vision": self.vision_params},
+            self.hf_config, self.qtype,
+        )
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        cfg = _qwen2_vl_text_config(hf)
+        vcfg = VisionConfig.from_hf(hf["vision_config"],
+                                    text_hidden=cfg.hidden_size)
+        return cls(cfg, vcfg, tree["text"], tree["vision"], hf, qtype)
+
+    # -- M-ROPE position ids (reference: Qwen2VL get_rope_index) ------------
+
+    def get_rope_index(self, input_ids: np.ndarray,
+                       image_grid_thw: list[tuple[int, int, int]]):
+        """input_ids [T] -> positions [3, T] + rope_delta (next text pos -
+        sequence length).  Single-row form; batching left-pads upstream."""
+        toks = np.asarray(input_ids)
+        t_len = len(toks)
+        pos = np.zeros((3, t_len), np.int32)
+        img_iter = iter(image_grid_thw)
+        st = 0          # next position value
+        i = 0
+        m = self.spatial_merge
+        while i < t_len:
+            if toks[i] == self.image_token_id:
+                t, h, w = next(img_iter)
+                gh, gw = h // m, w // m
+                n = t * gh * gw
+                t_idx = np.repeat(np.arange(t), gh * gw)
+                h_idx = np.tile(np.repeat(np.arange(gh), gw), t)
+                w_idx = np.tile(np.arange(gw), t * gh)
+                pos[0, i : i + n] = st + t_idx
+                pos[1, i : i + n] = st + h_idx
+                pos[2, i : i + n] = st + w_idx
+                st = pos[:, i : i + n].max() + 1
+                i += n
+            else:
+                pos[:, i] = st
+                st += 1
+                i += 1
+        return pos, int(st - t_len)
+
+    # -- forward / generate ---------------------------------------------------
+
+    def _embed_multimodal(self, input_ids: np.ndarray,
+                          pixel_values, image_grid_thw):
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        toks = jnp.asarray(np.asarray(input_ids, np.int32)[None])
+        x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
+        if pixel_values is not None:
+            img_embeds = []
+            off = 0
+            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            for thw in image_grid_thw:
+                n = int(np.prod(thw))
+                freqs = jnp.asarray(vision_rotary(self.vision_config,
+                                                  tuple(thw)))
+                img_embeds.append(vision_forward(
+                    self.vision_config, self.vision_params,
+                    px[off : off + n], freqs,
+                ))
+                off += n
+            img = jnp.concatenate(img_embeds).astype(x.dtype)
+            mask = np.asarray(input_ids) == self.image_token_id
+            (idx,) = np.nonzero(mask)
+            assert len(idx) == img.shape[0], (
+                f"{len(idx)} image tokens vs {img.shape[0]} image embeds"
+            )
+            x = x.at[0, jnp.asarray(idx)].set(img)
+        return x
+
+    def forward_logits(self, input_ids, pixel_values=None,
+                       image_grid_thw=()):
+        """Full-sequence logits [1, T, V] (parity/eval path)."""
+        from ipex_llm_tpu import kv as kv_mod
+        from ipex_llm_tpu.models.decoder import decoder_forward
+
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        x = self._embed_multimodal(ids, pixel_values, image_grid_thw)
+        pos, _ = self.get_rope_index(ids, list(image_grid_thw))
+        cache = kv_mod.make_cache(
+            "normal", self.config.num_layers, 1, len(ids),
+            self.config.num_kv_heads, self.config.head_dim,
+            v_head_dim=self.config.v_dim,
+        )
+        logits, _ = decoder_forward(
+            self.config, self.params, jnp.asarray(ids[None]), cache,
+            jnp.asarray(pos[None]), input_embeds=x,
+        )
+        return logits
+
+    def generate(self, input_ids, pixel_values=None, image_grid_thw=(),
+                 max_new_tokens: int = 32, **kwargs):
+        """Greedy image+text generation (batch 1)."""
+        from ipex_llm_tpu import kv as kv_mod
+
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        n_p = len(ids)
+        x = self._embed_multimodal(ids, pixel_values, image_grid_thw)
+        pos, delta = self.get_rope_index(ids, list(image_grid_thw))
+        cache = kv_mod.make_cache(
+            "normal", self.config.num_layers, 1, n_p + max_new_tokens,
+            self.config.num_kv_heads, self.config.head_dim,
+            v_head_dim=self.config.v_dim,
+        )
+        logits, cache = _mm_prefill(
+            self.config, self.params, cache, jnp.asarray(ids[None]),
+            jnp.asarray(pos[None]), x,
+        )
+        out = list(ids)
+        eos = self.hf_config.get("eos_token_id")
+        eos = set(eos) if isinstance(eos, list) else {eos}
+        tok = int(jnp.argmax(logits[0]))
+        for step in range(max_new_tokens):
+            out.append(tok)
+            if tok in eos:
+                break
+            # text continuation: all three channels advance together from
+            # the multimodal position max (rope_delta), not the slot index
+            p = n_p + step + delta
+            logits, cache = _mm_decode(
+                self.config, self.params, cache,
+                jnp.asarray([[tok]], jnp.int32),
+                jnp.full((1, 3, 1), p, jnp.int32),
+            )
+            tok = int(jnp.argmax(logits[0, -1]))
+        return np.asarray(out, np.int32)[None]
+
+
+AutoModelForVision2Seq = TPUModelForVision2Seq

@@ -1,0 +1,198 @@
+"""Qwen2-VL multimodal parity vs HF torch.
+
+Covers the vision tower (2D-rope ViT + spatial merger), image-token
+splicing, and 3-channel M-ROPE — the reference's qwen2_vl.py patch surface.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2vl(tmp_path_factory):
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    cfg = Qwen2VLConfig(
+        text_config=dict(
+            vocab_size=160, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            max_position_embeddings=256, tie_word_embeddings=False,
+        ),
+        vision_config=dict(
+            depth=2, embed_dim=32, num_heads=2, hidden_size=64,
+            patch_size=4, temporal_patch_size=1, spatial_merge_size=2,
+            in_channels=3,
+        ),
+        image_token_id=150, vision_start_token_id=151, vision_end_token_id=152,
+    )
+    torch.manual_seed(0)
+    model = Qwen2VLForConditionalGeneration(cfg).eval()
+    path = str(tmp_path_factory.mktemp("qwen2vl") / "m")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def _inputs():
+    rng = np.random.default_rng(3)
+    # one 4x4-patch image (t=1): 16 patches -> 4 merged image tokens
+    grid = (1, 4, 4)
+    pixels = rng.standard_normal((16, 3 * 1 * 4 * 4)).astype(np.float32)
+    ids = ([5, 9, 151] + [150] * 4 + [7, 11, 13])
+    return np.asarray(ids, np.int32), pixels, grid
+
+
+def test_qwen2vl_logits_parity(tiny_qwen2vl):
+    hf, path = tiny_qwen2vl
+    ids, pixels, grid = _inputs()
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(ids)[None].long(),
+            pixel_values=torch.from_numpy(pixels),
+            image_grid_thw=torch.tensor([list(grid)]),
+        ).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    model = AutoModelForVision2Seq.from_pretrained(path,
+                                                   load_in_low_bit="bf16")
+    got = np.asarray(model.forward_logits(ids, pixels, [grid]))
+    scale = np.abs(want).max()
+    err = np.abs(got - want).max() / scale
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+
+def test_qwen2vl_text_only_matches_plain_rope(tiny_qwen2vl):
+    """Without images, M-ROPE must reduce to plain rope positions."""
+    hf, path = tiny_qwen2vl
+    ids = np.asarray([5, 9, 3, 7, 11, 13, 2, 8], np.int32)
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids)[None].long()
+                  ).logits.float().numpy()
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    model = AutoModelForVision2Seq.from_pretrained(path,
+                                                   load_in_low_bit="bf16")
+    got = np.asarray(model.forward_logits(ids))
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_qwen2vl_generate_matches_hf(tiny_qwen2vl):
+    hf, path = tiny_qwen2vl
+    ids, pixels, grid = _inputs()
+    with torch.no_grad():
+        want = hf.generate(
+            input_ids=torch.from_numpy(ids)[None].long(),
+            pixel_values=torch.from_numpy(pixels),
+            image_grid_thw=torch.tensor([list(grid)]),
+            max_new_tokens=6, do_sample=False,
+        )[0, len(ids):].numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    model = AutoModelForVision2Seq.from_pretrained(path,
+                                                   load_in_low_bit="bf16")
+    got = model.generate(ids, pixels, [grid], max_new_tokens=6)[0, len(ids):]
+    assert (got[:4] == want[:4]).all(), (got, want)
+
+
+# ---------------------------------------------------------------------------
+# whisper (speech seq2seq) — reference transformers/models/whisper.py
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_whisper(tmp_path_factory):
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    cfg = WhisperConfig(
+        vocab_size=200, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=16,
+        max_source_positions=75, max_target_positions=64,
+        decoder_start_token_id=2, eos_token_id=3, pad_token_id=0,
+        bos_token_id=1, suppress_tokens=None, begin_suppress_tokens=None,
+    )
+    torch.manual_seed(0)
+    model = WhisperForConditionalGeneration(cfg).eval()
+    path = str(tmp_path_factory.mktemp("whisper") / "m")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def test_whisper_encoder_decoder_logits(tiny_whisper):
+    hf, path = tiny_whisper
+    rng = np.random.default_rng(5)
+    feats = rng.standard_normal((1, 16, 150)).astype(np.float32)
+    dec_ids = np.asarray([[2, 7, 11, 13]], np.int64)
+    with torch.no_grad():
+        want = hf(
+            input_features=torch.from_numpy(feats),
+            decoder_input_ids=torch.from_numpy(dec_ids),
+        ).logits.float().numpy()
+
+    from ipex_llm_tpu.models.whisper import (
+        KVCache, TPUWhisperForConditionalGeneration, decode_step, encode,
+    )
+
+    m = TPUWhisperForConditionalGeneration.from_pretrained(
+        path, load_in_low_bit="bf16")
+    import jax.numpy as jnp
+
+    enc = encode(m.config, m.params, jnp.asarray(feats))
+    cache = KVCache.init(m.config.decoder_layers, 1, 8,
+                         m.config.decoder_heads, m.config.head_dim)
+    got, _ = decode_step(m.config, m.params, enc,
+                         jnp.asarray(dec_ids.astype(np.int32)), cache,
+                         jnp.asarray(0, np.int32))
+    got = np.asarray(got)
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+
+def test_whisper_generate_matches_hf(tiny_whisper):
+    hf, path = tiny_whisper
+    rng = np.random.default_rng(6)
+    feats = rng.standard_normal((1, 16, 150)).astype(np.float32)
+    with torch.no_grad():
+        want = hf.generate(
+            input_features=torch.from_numpy(feats), max_new_tokens=6,
+            do_sample=False,
+        )[0].numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForSpeechSeq2Seq
+
+    m = AutoModelForSpeechSeq2Seq.from_pretrained(path,
+                                                  load_in_low_bit="bf16")
+    got = m.generate(feats, max_new_tokens=6)[0]
+    n = min(len(want), len(got), 5)
+    assert (got[:n] == want[:n]).all(), (got, want)
+
+
+def test_multimodal_save_load_low_bit(tiny_qwen2vl, tiny_whisper, tmp_path):
+    from ipex_llm_tpu.models.whisper import TPUWhisperForConditionalGeneration
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    _, vpath = tiny_qwen2vl
+    m = AutoModelForVision2Seq.from_pretrained(vpath, load_in_low_bit="sym_int4")
+    ids, pixels, grid = _inputs()
+    want = m.generate(ids, pixels, [grid], max_new_tokens=4)
+    m.save_low_bit(str(tmp_path / "vl"))
+    m2 = AutoModelForVision2Seq.load_low_bit(str(tmp_path / "vl"))
+    got = m2.generate(ids, pixels, [grid], max_new_tokens=4)
+    assert (want == got).all()
+
+    _, wpath = tiny_whisper
+    w = TPUWhisperForConditionalGeneration.from_pretrained(
+        wpath, load_in_low_bit="sym_int4")
+    feats = np.random.default_rng(9).standard_normal((16, 150)).astype(np.float32)
+    want_w = w.generate(feats, max_new_tokens=4)
+    w.save_low_bit(str(tmp_path / "wh"))
+    w2 = TPUWhisperForConditionalGeneration.load_low_bit(str(tmp_path / "wh"))
+    got_w = w2.generate(feats, max_new_tokens=4)
+    assert (want_w == got_w).all()
