@@ -301,39 +301,20 @@ def _mlp_block(cfg: ModelConfig, lp: dict, x):
     return out
 
 
-def decoder_forward(
-    cfg: ModelConfig,
-    params: dict[str, Any],
-    tokens: jnp.ndarray,            # [B, T] int32
-    cache: KVCache,
-    rope_positions: jnp.ndarray,    # [B, T] logical positions (left-pad aware)
-    kv_start: jnp.ndarray | None = None,  # [B] first valid cache slot
-    last_token_only: bool = False,
-    collect_obs: int = 0,
-    slot_offsets: jnp.ndarray | None = None,  # [B] per-row cache write slots
-    input_embeds: jnp.ndarray | None = None,  # [B, T, H] bypasses the lookup
-):
-    """Run the decoder; returns (logits, updated cache).
-
-    logits: [B, V] if last_token_only else [B, T, V].
-
-    ``collect_obs=W`` (static, prefill-only) additionally returns the last-W
-    post-RoPE queries of every layer ``[L, B, W, Hq, D]`` — the SnapKV
-    observation window used by compresskv.compress (reference kv.py:221).
-
-    ``slot_offsets`` [B] overrides the uniform ``cache.length`` write slot
-    with per-row offsets (continuous batching); the returned cache's
-    ``length`` is then left untouched — the caller tracks row lengths.
-    """
+def embed_prelude(cfg: ModelConfig, params, tokens, rope_positions,
+                  input_embeds=None):
+    """Embedding + positional prelude shared by decoder_forward and the
+    pipeline microbatch scheduler (parallel/pipeline.py): token (or spliced
+    multimodal) embeddings, embedding multiplier/norm, learned positions,
+    and the rope/M-ROPE cos-sin tables.  Returns (x, cos, sin)."""
     from ipex_llm_tpu.ops.embedding import embed_lookup
 
-    b, t = tokens.shape
-    embed = params["embed"]
+    b = tokens.shape[0]
     if input_embeds is not None:
         # multimodal path: image features already spliced into the stream
         x = input_embeds.astype(COMPUTE_DTYPE)
     else:
-        x = embed_lookup(embed, tokens, COMPUTE_DTYPE)
+        x = embed_lookup(params["embed"], tokens, COMPUTE_DTYPE)
     if cfg.embedding_multiplier != 1.0:
         x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
     if cfg.learned_pos:
@@ -367,33 +348,52 @@ def decoder_forward(
             cos, sin = rope_ops.cos_sin(
                 rope_positions, frozen("inv_freq"), frozen("rope_mscale", 1.0)
             )
+    return x, cos, sin
 
-    alibi_bias = None
 
-    if slot_offsets is not None:
-        slot0 = slot_offsets                       # [B]
-        q_slots = slot0[:, None] + jnp.arange(t)[None, :]
-        kv_len = slot0 + t
+def alibi_bias_for(cfg: ModelConfig, q_slots, s: int):
+    """ALiBi bias [B, H, T, S] (bloom/mpt/baichuan-13b): slope *
+    (k_pos - q_pos), identical for every layer — built ONCE per forward
+    (like cos/sin), never inside the scan body.  Slot arithmetic cancels
+    kv_start, so left-padding is transparent."""
+    slopes = alibi_slopes(cfg.num_heads)
+    kv_pos = jnp.arange(s, dtype=jnp.float32)
+    dist = kv_pos[None, None, None, :] - q_slots.astype(jnp.float32)[
+        :, None, :, None]                           # [B,1,T,S] (<=0 causal)
+    return slopes[None, :, None, None] * dist
+
+
+def logits_tail(cfg: ModelConfig, params, x):
+    """Final norm + lm head + logit scale/softcap — the post-stack tail
+    shared by decoder_forward and the pipeline scheduler."""
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
+    lm_head = params.get("lm_head")
+    if lm_head is None:  # tied embeddings
+        logits = jnp.matmul(
+            x.astype(COMPUTE_DTYPE), params["embed"].T.astype(COMPUTE_DTYPE),
+            preferred_element_type=jnp.float32,
+        )
     else:
-        slot0 = cache.length
-        q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
-        kv_len = jnp.broadcast_to(slot0 + t, (b,))
+        logits = linear_ops.linear(
+            x, lm_head, params.get("lm_head_bias")
+        ).astype(jnp.float32)
+    if cfg.logit_scale != 1.0:  # cohere
+        logits = logits * cfg.logit_scale
+    if cfg.logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+    return logits.astype(jnp.float32)
 
-    if cfg.alibi:
-        # ALiBi (bloom/mpt/baichuan-13b): slope * (k_pos - q_pos), identical
-        # for every layer — built ONCE here (like cos/sin), never inside the
-        # scan body.  Slot arithmetic cancels kv_start, so left-padding is
-        # transparent.
-        s = cache.max_len
-        slopes = alibi_slopes(cfg.num_heads)
-        kv_pos = jnp.arange(s, dtype=jnp.float32)
-        dist = kv_pos[None, None, None, :] - q_slots.astype(jnp.float32)[
-            :, None, :, None]                       # [B,1,T,S] (<=0 causal)
-        alibi_bias = slopes[None, :, None, None] * dist
 
-    sliding_flags = jnp.array(
-        [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
-    )
+def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
+               x, cos, sin, slot0, q_slots, kv_len, kv_start, cache,
+               collect_obs: int = 0, alibi_bias=None):
+    """Scan one stacked layer tree over its cache slice.
+
+    The single compiled layer body shared by decoder_forward and the
+    pipeline-parallel microbatch scheduler (parallel/pipeline.py), which
+    runs each stage's LOCAL chunk of layers through this same function
+    inside shard_map.  Returns (x, k_new, v_new, obs_q).
+    """
 
     def body(x, xs):
         lp, kl, vl, sliding = xs
@@ -410,6 +410,61 @@ def decoder_forward(
             x = x + ffn(cfg, lp, x)
         return x, (kl, vl, obs_q)
 
+    x, (k_new, v_new, obs_q) = jax.lax.scan(
+        body, x, (tree, k_stack, v_stack, sliding_flags)
+    )
+    return x, k_new, v_new, obs_q
+
+
+def decoder_forward(
+    cfg: ModelConfig,
+    params: dict[str, Any],
+    tokens: jnp.ndarray,            # [B, T] int32
+    cache: KVCache,
+    rope_positions: jnp.ndarray,    # [B, T] logical positions (left-pad aware)
+    kv_start: jnp.ndarray | None = None,  # [B] first valid cache slot
+    last_token_only: bool = False,
+    collect_obs: int = 0,
+    slot_offsets: jnp.ndarray | None = None,  # [B] per-row cache write slots
+    input_embeds: jnp.ndarray | None = None,  # [B, T, H] bypasses the lookup
+):
+    """Run the decoder; returns (logits, updated cache).
+
+    logits: [B, V] if last_token_only else [B, T, V].
+
+    ``collect_obs=W`` (static, prefill-only) additionally returns the last-W
+    post-RoPE queries of every layer ``[L, B, W, Hq, D]`` — the SnapKV
+    observation window used by compresskv.compress (reference kv.py:221).
+
+    ``slot_offsets`` [B] overrides the uniform ``cache.length`` write slot
+    with per-row offsets (continuous batching); the returned cache's
+    ``length`` is then left untouched — the caller tracks row lengths.
+    """
+    from ipex_llm_tpu.ops.embedding import embed_lookup
+
+    b, t = tokens.shape
+    embed = params["embed"]
+    x, cos, sin = embed_prelude(cfg, params, tokens, rope_positions,
+                                input_embeds)
+
+    alibi_bias = None
+
+    if slot_offsets is not None:
+        slot0 = slot_offsets                       # [B]
+        q_slots = slot0[:, None] + jnp.arange(t)[None, :]
+        kv_len = slot0 + t
+    else:
+        slot0 = cache.length
+        q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
+        kv_len = jnp.broadcast_to(slot0 + t, (b,))
+
+    if cfg.alibi:
+        alibi_bias = alibi_bias_for(cfg, q_slots, cache.max_len)
+
+    sliding_flags = jnp.array(
+        [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
+    )
+
     # deepseek-style dense-prefix models carry two layer stacks (plain-MLP
     # prefix + MoE rest, models/build.py); each runs its own scan over its
     # cache slice so every stack still compiles one layer body
@@ -421,9 +476,10 @@ def decoder_forward(
         stacks = [(params["layers"], 0, cfg.num_layers)]
     k_parts, v_parts, obs_parts = [], [], []
     for tree, lo, hi in stacks:
-        x, (kp, vp, op) = jax.lax.scan(
-            body, x, (tree, cache.k[lo:hi], cache.v[lo:hi],
-                      sliding_flags[lo:hi])
+        x, kp, vp, op = run_layers(
+            cfg, tree, cache.k[lo:hi], cache.v[lo:hi], sliding_flags[lo:hi],
+            x, cos, sin, slot0, q_slots, kv_len, kv_start, cache,
+            collect_obs, alibi_bias,
         )
         k_parts.append(kp)
         v_parts.append(vp)
@@ -433,25 +489,13 @@ def decoder_forward(
     obs_q = (jnp.concatenate(obs_parts) if len(obs_parts) > 1
              else obs_parts[0])
 
-    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
-
     if last_token_only:
-        x = x[:, -1, :]  # left-padding puts every sequence's last token at T-1
-
-    lm_head = params.get("lm_head")
-    if lm_head is None:  # tied embeddings
-        logits = jnp.matmul(
-            x.astype(COMPUTE_DTYPE), embed.T.astype(COMPUTE_DTYPE),
-            preferred_element_type=jnp.float32,
-        )
-    else:
-        logits = linear_ops.linear(
-            x, lm_head, params.get("lm_head_bias")
-        ).astype(jnp.float32)
-    if cfg.logit_scale != 1.0:  # cohere
-        logits = logits * cfg.logit_scale
-    if cfg.logit_softcap is not None:
-        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+        # left-padding puts every sequence's last token at T-1; slice BEFORE
+        # the norm+head tail so decode steps never project the full window
+        x = x[:, -1:, :]
+    logits = logits_tail(cfg, params, x)
+    if last_token_only:
+        logits = logits[:, 0]
 
     new_len = cache.length if slot_offsets is not None else slot0 + t
     new_cache = replace(cache, k=k_new, v=v_new, length=new_len)
