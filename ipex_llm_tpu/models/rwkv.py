@@ -1,0 +1,238 @@
+"""RWKV-v4 recurrent LM (linear-attention family).
+
+Reference counterpart: transformers/models/rwkv4.py + rwkv5.py (the
+reference rewrites HF's python WKV loop with fused CPU/XPU ops).  RWKV has
+no KV cache at all — per-layer recurrent state — so it gets a dedicated
+module like whisper:
+
+- the WKV recurrence runs as ONE ``lax.scan`` over time with the
+  numerically-stable (aa, bb, pp) log-sum state, vectorized over
+  batch x channels (the shape XLA maps to the VPU);
+- full-sequence forward (training/eval/prefill) and single-token stepping
+  (decode) share the same scan body; decode carries the state pytree
+  instead of a cache — O(1) memory in sequence length;
+- projection matrices quantize like decoder weights; mixes/decays stay
+  fp32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class RwkvConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    intermediate_size: int
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 0
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "RwkvConfig":
+        h = hf["hidden_size"]
+        if hf.get("attention_hidden_size", h) != h:
+            raise NotImplementedError(
+                "rwkv with attention_hidden_size != hidden_size is not "
+                "supported (WKV state is sized by hidden_size)"
+            )
+        return cls(
+            vocab_size=hf["vocab_size"], hidden_size=h,
+            num_layers=hf["num_hidden_layers"],
+            intermediate_size=hf.get("intermediate_size") or 4 * h,
+            layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            eos_token_id=hf.get("eos_token_id", 0),
+        )
+
+
+def build_rwkv_params(cfg: RwkvConfig, get, has, qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    def ln(name):
+        return {"w": jnp.asarray(get(name + ".weight"), jnp.float32),
+                "b": jnp.asarray(get(name + ".bias"), jnp.float32)}
+
+    p: dict[str, Any] = {"embed": jnp.asarray(get("rwkv.embeddings.weight"),
+                                              jnp.bfloat16)}
+    p["pre_ln"] = ln("rwkv.blocks.0.pre_ln")
+    layers = []
+    for i in range(cfg.num_layers):
+        b = f"rwkv.blocks.{i}"
+        a = b + ".attention"
+        f = b + ".feed_forward"
+        lp = {
+            "ln1": ln(b + ".ln1"), "ln2": ln(b + ".ln2"),
+            "time_decay": jnp.asarray(get(a + ".time_decay"), jnp.float32),
+            "time_first": jnp.asarray(get(a + ".time_first"), jnp.float32),
+            "mix_k": jnp.asarray(get(a + ".time_mix_key"), jnp.float32).reshape(-1),
+            "mix_v": jnp.asarray(get(a + ".time_mix_value"), jnp.float32).reshape(-1),
+            "mix_r": jnp.asarray(get(a + ".time_mix_receptance"), jnp.float32).reshape(-1),
+            "wk": quantize_weight(get(a + ".key.weight"), qtype),
+            "wv": quantize_weight(get(a + ".value.weight"), qtype),
+            "wr": quantize_weight(get(a + ".receptance.weight"), qtype),
+            "wo": quantize_weight(get(a + ".output.weight"), qtype),
+            "fmix_k": jnp.asarray(get(f + ".time_mix_key"), jnp.float32).reshape(-1),
+            "fmix_r": jnp.asarray(get(f + ".time_mix_receptance"), jnp.float32).reshape(-1),
+            "fk": quantize_weight(get(f + ".key.weight"), qtype),
+            "fr": quantize_weight(get(f + ".receptance.weight"), qtype),
+            "fv": quantize_weight(get(f + ".value.weight"), qtype),
+        }
+        layers.append(lp)
+    p["layers"] = stack_layer_trees(layers)
+    p["ln_out"] = ln("rwkv.ln_out")
+    p["head"] = quantize_weight(get("head.weight"), qtype)
+    return p
+
+
+def _wkv_scan(k, v, w, u, state):
+    """Stable WKV recurrence.  k/v [B,T,C]; w,u [C]; state (aa,bb,pp) [B,C].
+
+    Returns (wkv [B,T,C], new state)."""
+
+    def step(carry, kv_t):
+        aa, bb, pp = carry
+        kt, vt = kv_t
+        ww = u + kt
+        p = jnp.maximum(pp, ww)
+        e1 = jnp.exp(pp - p)
+        e2 = jnp.exp(ww - p)
+        out = (e1 * aa + e2 * vt) / (e1 * bb + e2)
+        ww2 = pp + w
+        p2 = jnp.maximum(ww2, kt)
+        e1b = jnp.exp(ww2 - p2)
+        e2b = jnp.exp(kt - p2)
+        return (e1b * aa + e2b * vt, e1b * bb + e2b, p2), out
+
+    ks = jnp.moveaxis(k, 1, 0)   # [T,B,C]
+    vs = jnp.moveaxis(v, 1, 0)
+    state, outs = jax.lax.scan(step, state, (ks, vs))
+    return jnp.moveaxis(outs, 0, 1), state
+
+
+def _token_shift(x, prev):
+    """x [B,T,C] -> previous-token stream; ``prev`` [B,C] carries across
+    calls (zeros at sequence start)."""
+    return jnp.concatenate([prev[:, None], x[:, :-1]], axis=1)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def rwkv_forward(cfg: RwkvConfig, params: dict, tokens: jnp.ndarray,
+                 state: dict | None = None):
+    """tokens [B,T] -> (logits [B,T,V], state).
+
+    ``state`` carries (att_x, ffn_x [B,C] token-shift streams and the
+    (aa, bb, pp) WKV state per layer, each [L,B,C]); None = fresh."""
+    b, t = tokens.shape
+    c = cfg.hidden_size
+    x = params["embed"][tokens].astype(jnp.float32)
+    x = layer_norm(x, params["pre_ln"]["w"], params["pre_ln"]["b"],
+                   cfg.layer_norm_eps)
+    if state is None:
+        z = jnp.zeros((cfg.num_layers, b, c), jnp.float32)
+        state = {"att_x": z, "ffn_x": z, "aa": z, "bb": z,
+                 "pp": jnp.full((cfg.num_layers, b, c), -1e30, jnp.float32)}
+
+    def block(x, xs):
+        lp, att_x, ffn_x, aa, bb, pp = xs
+        h = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"], cfg.layer_norm_eps)
+        hx = _token_shift(h, att_x)
+        xk = h * lp["mix_k"] + hx * (1 - lp["mix_k"])
+        xv = h * lp["mix_v"] + hx * (1 - lp["mix_v"])
+        xr = h * lp["mix_r"] + hx * (1 - lp["mix_r"])
+        r = jax.nn.sigmoid(linear_ops.linear(xr.astype(jnp.bfloat16), lp["wr"])
+                           .astype(jnp.float32))
+        k = linear_ops.linear(xk.astype(jnp.bfloat16), lp["wk"]).astype(jnp.float32)
+        v = linear_ops.linear(xv.astype(jnp.bfloat16), lp["wv"]).astype(jnp.float32)
+        w = -jnp.exp(lp["time_decay"])
+        wkv, (aa, bb, pp) = _wkv_scan(k, v, w, lp["time_first"], (aa, bb, pp))
+        x = x + linear_ops.linear((r * wkv).astype(jnp.bfloat16), lp["wo"]
+                                  ).astype(jnp.float32)
+        att_x = h[:, -1]
+
+        h2 = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"], cfg.layer_norm_eps)
+        h2x = _token_shift(h2, ffn_x)
+        fxk = h2 * lp["fmix_k"] + h2x * (1 - lp["fmix_k"])
+        fxr = h2 * lp["fmix_r"] + h2x * (1 - lp["fmix_r"])
+        fr = jax.nn.sigmoid(linear_ops.linear(fxr.astype(jnp.bfloat16), lp["fr"])
+                            .astype(jnp.float32))
+        fk = jnp.square(jax.nn.relu(
+            linear_ops.linear(fxk.astype(jnp.bfloat16), lp["fk"])
+            .astype(jnp.float32)
+        ))
+        x = x + fr * linear_ops.linear(fk.astype(jnp.bfloat16), lp["fv"]
+                                       ).astype(jnp.float32)
+        ffn_x = h2[:, -1]
+        return x, (att_x, ffn_x, aa, bb, pp)
+
+    x, (att_x, ffn_x, aa, bb, pp) = jax.lax.scan(
+        block, x,
+        (params["layers"], state["att_x"], state["ffn_x"], state["aa"],
+         state["bb"], state["pp"]),
+    )
+    x = layer_norm(x, params["ln_out"]["w"], params["ln_out"]["b"],
+                   cfg.layer_norm_eps)
+    logits = linear_ops.linear(x.astype(jnp.bfloat16), params["head"]
+                               ).astype(jnp.float32)
+    return logits, {"att_x": att_x, "ffn_x": ffn_x, "aa": aa, "bb": bb,
+                    "pp": pp}
+
+
+class TPURwkvForCausalLM:
+    """RWKV drop-in: recurrent state instead of a KV cache."""
+
+    def __init__(self, cfg: RwkvConfig, params: dict, hf_config: dict,
+                 qtype: str):
+        self.config = cfg
+        self.params = params
+        self.hf_config = hf_config
+        self.qtype = qtype
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.loader import CheckpointReader, read_config
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf = read_config(path)
+        cfg = RwkvConfig.from_hf(hf)
+        reader = CheckpointReader(path)
+        params = build_rwkv_params(cfg, reader.get, reader.has, qtype)
+        return cls(cfg, params, hf, qtype)
+
+    def __call__(self, input_ids):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        logits, _ = rwkv_forward(self.config, self.params, jnp.asarray(ids))
+        return logits
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 2 and ids.shape[0] != 1:
+            raise NotImplementedError("rwkv generate supports batch size 1")
+        ids = ids.reshape(-1)
+        logits, state = rwkv_forward(self.config, self.params,
+                                     jnp.asarray(ids[None]))
+        out = list(ids)
+        eos = self.config.eos_token_id
+        for step in range(max_new_tokens):
+            tok = int(jnp.argmax(logits[0, -1]))
+            out.append(tok)
+            if tok == eos or step == max_new_tokens - 1:
+                break
+            logits, state = rwkv_forward(
+                self.config, self.params, jnp.asarray([[tok]], jnp.int32),
+                state,
+            )
+        return np.asarray(out, np.int32)[None]

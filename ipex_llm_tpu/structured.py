@@ -511,6 +511,20 @@ class JsonValidator:
         return False
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _json_decode_step(cfg, params, cache, tok, pos, kv_start):
+    """One compiled single-token step shared by every generate_json call
+    (module-level so jit's cache survives across documents; the r3 eager
+    version dispatched thousands of tiny CPU executables per document)."""
+    from ipex_llm_tpu.models.decoder import decoder_forward
+
+    return decoder_forward(cfg, params, tok, cache, pos,
+                           kv_start=kv_start, last_token_only=True)
+
+
 def generate_json(
     cfg,
     params,
@@ -527,7 +541,6 @@ def generate_json(
     enum/const)."""
     from ipex_llm_tpu import kv as kv_mod
     from ipex_llm_tpu.generation import _round_up, prefill_step
-    from ipex_llm_tpu.models.decoder import decoder_forward
 
     n_p = len(prompt_ids)
     tpad = _round_up(n_p, 16)
@@ -580,10 +593,8 @@ def generate_json(
             break
         pos = jnp.asarray([[n_p + step]], jnp.int32)
         tok = jnp.asarray([[chosen]], jnp.int32)
-        logits, cache = decoder_forward(
-            cfg, params, tok, cache, pos, kv_start=kv_start,
-            last_token_only=True,
-        )
+        logits, cache = _json_decode_step(cfg, params, cache, tok, pos,
+                                          kv_start)
 
     if not validator.done:
         # grammar-forced closure (the xgrammar "forced token" idea): the

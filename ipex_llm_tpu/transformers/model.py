@@ -100,6 +100,15 @@ class TPUModelForCausalLM:
         kwargs.pop("trust_remote_code", None)
 
         hf_config = read_config(path)
+        if hf_config.get("model_type") == "rwkv":
+            # recurrent family: state instead of a KV cache (models/rwkv.py)
+            from ipex_llm_tpu.models.rwkv import TPURwkvForCausalLM
+
+            if mesh is not None:
+                raise NotImplementedError("rwkv SPMD sharding not supported")
+            return TPURwkvForCausalLM.from_pretrained(
+                path, load_in_low_bit=qtype
+            )
         family = get_family(hf_config.get("model_type", "llama"))
         cfg = family.to_config(hf_config)
         reader = CheckpointReader(path)

@@ -196,3 +196,40 @@ def test_multimodal_save_load_low_bit(tiny_qwen2vl, tiny_whisper, tmp_path):
     w2 = TPUWhisperForConditionalGeneration.load_low_bit(str(tmp_path / "wh"))
     got_w = w2.generate(feats, max_new_tokens=4)
     assert (want_w == got_w).all()
+
+
+# ---------------------------------------------------------------------------
+# rwkv4 (recurrent family) — reference transformers/models/rwkv4.py
+# ---------------------------------------------------------------------------
+
+
+def test_rwkv_logits_and_state_decode(tmp_path):
+    from transformers import RwkvConfig, RwkvForCausalLM
+
+    cfg = RwkvConfig(vocab_size=150, hidden_size=64, num_hidden_layers=2,
+                     attention_hidden_size=64, intermediate_size=128,
+                     context_length=128)
+    torch.manual_seed(0)
+    hf = RwkvForCausalLM(cfg).eval()
+    path = str(tmp_path / "rwkv")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    ids = np.random.default_rng(2).integers(0, 150, (1, 12)).astype(np.int64)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m(ids.astype(np.int32)))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+    # stateful single-token decode must match HF's greedy roll
+    with torch.no_grad():
+        want_gen = hf.generate(torch.from_numpy(ids), max_new_tokens=6,
+                               do_sample=False)[0, ids.shape[1]:].numpy()
+    got_gen = m.generate(ids[0].astype(np.int32), max_new_tokens=6)
+    got_gen = got_gen[0, ids.shape[1]:]
+    assert (got_gen[:5] == want_gen[:5]).all(), (got_gen, want_gen)
