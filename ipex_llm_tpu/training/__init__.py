@@ -20,6 +20,7 @@ from ipex_llm_tpu.training.qlora import (
     make_qlora_train_step,
     merge_lora,
 )
+from ipex_llm_tpu.training.checkpoint import TrainCheckpointer
 from ipex_llm_tpu.training.relora import ReLoRATrainer, jagged_cosine_schedule
 from ipex_llm_tpu.training.lisa import LisaTrainer, make_lisa_train_step
 
@@ -27,6 +28,6 @@ __all__ = [
     "causal_lm_loss", "make_train_step",
     "LoraConfig", "LoraWeight", "attach_lora", "get_peft_model",
     "init_lora", "make_qlora_train_step", "merge_lora",
-    "ReLoRATrainer", "jagged_cosine_schedule",
+    "ReLoRATrainer", "jagged_cosine_schedule", "TrainCheckpointer",
     "LisaTrainer", "make_lisa_train_step",
 ]

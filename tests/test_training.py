@@ -168,3 +168,43 @@ def test_lisa_masks_frozen_layers():
 def test_sample_active_layers():
     m = sample_active_layers(jax.random.PRNGKey(0), 8, 3)
     assert int(m.sum()) == 3
+
+
+def test_train_checkpoint_resume(cfg_params_int4, tmp_path):
+    """Orbax round-trip of (quantized params, optimizer state, step):
+    resumed training must continue bit-identically (SURVEY §5
+    checkpoint/resume)."""
+    import optax
+
+    from ipex_llm_tpu.training.checkpoint import TrainCheckpointer
+
+    cfg, params = cfg_params_int4
+    lc = LoraConfig(r=4, lora_alpha=8)
+    adapters = init_lora(jax.random.PRNGKey(2), cfg, params, lc)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(adapters)
+    step_fn = make_qlora_train_step(cfg, opt, lc)
+    tokens = _batch(cfg, b=1, t=16, seed=9)
+
+    for _ in range(3):
+        adapters, opt_state, loss = step_fn(adapters, opt_state, tokens,
+                                            params)
+
+    ck = TrainCheckpointer(str(tmp_path / "ckpt"), max_to_keep=2)
+    ck.save(3, adapters, opt_state, extras={"note": "r3"}, wait=True)
+    assert ck.latest_step() == 3
+
+    # continue the original run two more steps (the gold trajectory)
+    a_gold, o_gold = adapters, opt_state
+    for _ in range(2):
+        a_gold, o_gold, gold_loss = step_fn(a_gold, o_gold, tokens, params)
+
+    # resume from disk and replay the same two steps
+    restored = ck.restore({"params": adapters, "opt_state": opt_state,
+                           "extras": {"note": "x"}})
+    a_res, o_res = restored["params"], restored["opt_state"]
+    assert restored["extras"]["note"] == "r3"
+    for _ in range(2):
+        a_res, o_res, res_loss = step_fn(a_res, o_res, tokens, params)
+    assert float(res_loss) == float(gold_loss)
+    ck.close()
