@@ -164,9 +164,32 @@ def cached_sdpa(
     from ipex_llm_tpu.ops import dispatch
 
     if hasattr(cache, "tables"):
-        # paged pool layer (serving engine): gather the rows' pages into the
-        # head-major [B, Hkv, S, D] view; tail pages beyond kv_len are
-        # garbage and masked exactly like dense-cache slack
+        # paged pool layer (serving engine)
+        if (
+            q.shape[1] == 1
+            and kwargs.get("bias") is None
+            and kwargs.get("window") is None
+            and kwargs.get("softcap") is None
+            and kwargs.get("kv_start") is None   # paged rows start at slot 0
+            and kwargs.get("kv_len") is not None
+            and q.shape[2] % kl.shape[1] == 0
+            and dispatch.spmd_mesh() is None
+            and dispatch.use_pallas()
+        ):
+            # decode: read ONLY the row's own pages through the
+            # scalar-prefetched block table — no table-width gather
+            try:
+                from ipex_llm_tpu.ops.pallas import paged_attention
+
+                return paged_attention.paged_decode_sdpa(
+                    q, kl, vl, cache.tables, kwargs.get("kv_len"),
+                    scale=kwargs.get("scale"),
+                )
+            except (ImportError, NotImplementedError):
+                pass
+        # fallback: gather the rows' pages into the head-major
+        # [B, Hkv, S, D] view; tail pages beyond kv_len are garbage and
+        # masked exactly like dense-cache slack
         kl = cache.gather_layer(kl)
         vl = cache.gather_layer(vl)
 

@@ -1,0 +1,158 @@
+"""Paged decode attention: block-table KV read directly from the page pool.
+
+Reference counterpart: the role vLLM's PagedAttention kernels play for the
+reference's serving stack (SURVEY §2.1 vllm/).  The r3 fallback gathers a
+row's pages into a contiguous [R, H, S_max, D] buffer every step — correct,
+but it materializes table-width KV per layer.  This kernel instead uses
+Pallas **scalar-prefetched block tables**: the grid's page axis indexes the
+pool THROUGH the table inside each BlockSpec index_map, so the DMA engine
+streams exactly the row's own pages (invalid tail pages clip to the
+engine's scratch page 0 and are masked by ``kv_len``).
+
+Same online-softmax structure as ops/pallas/decode_attention.py; rows are
+right-aligned from slot 0 (the paged engine's invariant), so there is no
+``kv_start``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, scale, ps, compute_dtype):
+    r = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = len_ref[r]
+    lo = pi * ps
+    tile_live = lo < kv_len
+
+    @pl.when(tile_live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)             # [G, D]
+        k = k_ref[0, 0].astype(compute_dtype).astype(jnp.float32)  # [ps, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # [G, ps]
+        g = s.shape[0]
+        kpos = lo + jax.lax.broadcasted_iota(jnp.int32, (g, ps), 1)
+        s = jnp.where(kpos < kv_len, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.maximum(m_new, -1e29)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(jnp.maximum(m_prev, -1e29) - m_safe)
+        v = v_ref[0, 0].astype(compute_dtype)            # [ps, Dv]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(compute_dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(pi == pl.num_programs(2) - 1)
+    def _():
+        denom = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "out_dtype"))
+def _paged(q, k_pool, v_pool, tables, kv_len, *, scale, out_dtype):
+    """q [R, Hkv, G, D]; k/v_pool [P, Hkv, ps, D(v)]; tables [R, maxP];
+    kv_len [R]."""
+    r, hkv, g, d = q.shape
+    n_pages, _, ps, dv = v_pool.shape
+
+    g_pad = _round_up(g, 8)
+    d_pad = _round_up(d, 128)
+    dv_pad = _round_up(dv, 128)
+    if (g_pad, d_pad) != (g, d):
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, g_pad - g), (0, d_pad - d)))
+    if d_pad != d:
+        k_pool = jnp.pad(k_pool, ((0, 0), (0, 0), (0, 0), (0, d_pad - d)))
+    if dv_pad != dv:
+        v_pool = jnp.pad(v_pool, ((0, 0), (0, 0), (0, 0), (0, dv_pad - dv)))
+    # unallocated (-1) table slots clip to the engine scratch page 0; their
+    # positions sit beyond kv_len and are masked in-kernel
+    tables = jnp.clip(tables, 0, n_pages - 1).astype(jnp.int32)
+    maxp = tables.shape[1]
+
+    def k_map(ri, hi, pi, tables_ref, len_ref):
+        return (tables_ref[ri, pi], hi, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r, hkv, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, g_pad, d_pad),
+                         lambda ri, hi, pi, t, n: (ri, hi, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d_pad), k_map),
+            pl.BlockSpec((1, 1, ps, dv_pad), k_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g_pad, dv_pad),
+                               lambda ri, hi, pi, t, n: (ri, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, dv_pad), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, ps=ps,
+                          compute_dtype=jnp.bfloat16),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, hkv, g_pad, dv_pad), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(tables, kv_len.astype(jnp.int32), q, k_pool, v_pool)
+    return out[:, :, :g, :dv]
+
+
+def paged_decode_sdpa(
+    q: jnp.ndarray,            # [R, 1, Hq, D]
+    k_pool: jnp.ndarray,       # [P, Hkv, ps, D] pool layer
+    v_pool: jnp.ndarray,       # [P, Hkv, ps, Dv]
+    tables: jnp.ndarray,       # [R, maxP] int32 (-1 = unallocated)
+    kv_len: jnp.ndarray,       # [R]
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """T=1 attention straight off the paged pool; returns [R, 1, Hq, Dv]."""
+    r, t, hq, d = q.shape
+    assert t == 1, "paged kernel is specialized for single-token steps"
+    hkv = k_pool.shape[1]
+    if hq % hkv:
+        raise NotImplementedError("Hq must be a multiple of Hkv")
+    g = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+    qg = q[:, 0].reshape(r, hkv, g, d)
+    out = _paged(qg, k_pool, v_pool, tables, kv_len,
+                 scale=float(scale), out_dtype=q.dtype)
+    return out.reshape(r, 1, hq, v_pool.shape[-1])

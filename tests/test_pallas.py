@@ -171,3 +171,96 @@ def test_flash_bf16_long_prefill():
     want = np.asarray(sdpa_reference(q, k, v)).astype(np.float32)
     got = np.asarray(flash_sdpa(q, k, v)).astype(np.float32)
     np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+def test_paged_decode_kernel_matches_gather(monkeypatch):
+    """Scalar-prefetch paged attention == gather-then-dense reference."""
+    import numpy as np
+
+    from ipex_llm_tpu.kv import PagedKVCache
+    from ipex_llm_tpu.ops.attention import sdpa_reference
+    from ipex_llm_tpu.ops.pallas.paged_attention import paged_decode_sdpa
+
+    rng = np.random.default_rng(31)
+    R, hkv, hq, d, ps, n_pages, maxp = 3, 2, 4, 16, 32, 9, 4
+    cache = PagedKVCache.init(1, n_pages, R, maxp, hkv, ps, d)
+    k_pool = jnp.asarray(rng.standard_normal((n_pages, hkv, ps, d)),
+                         jnp.bfloat16)
+    v_pool = jnp.asarray(rng.standard_normal((n_pages, hkv, ps, d)),
+                         jnp.bfloat16)
+    # rows with different lengths and scattered pages (page 0 = scratch)
+    tables = np.full((R, maxp), -1, np.int32)
+    tables[0, :2] = [3, 5]
+    tables[1, :4] = [1, 7, 2, 8]
+    tables[2, :1] = [6]
+    kv_len = np.asarray([40, 120, 7], np.int32)
+    cache = cache.__class__(k=k_pool[None], v=v_pool[None],
+                            tables=jnp.asarray(tables), length=cache.length)
+
+    q = jnp.asarray(rng.standard_normal((R, 1, hq, d)), jnp.bfloat16)
+    got = paged_decode_sdpa(q, k_pool, v_pool, jnp.asarray(tables),
+                            jnp.asarray(kv_len))
+
+    kd = cache.gather_layer(k_pool).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+    vd = cache.gather_layer(v_pool).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+    qpos = (jnp.asarray(kv_len) - 1)[:, None]
+    want = sdpa_reference(q, kd, vd, causal=True, q_positions=qpos,
+                          kv_len=jnp.asarray(kv_len))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_serving_engine_uses_paged_kernel(monkeypatch):
+    """End-to-end: the engine's decode step through the paged kernel
+    (interpret mode) matches plain generate."""
+    import numpy as np
+
+    from ipex_llm_tpu.generation import GenerationConfig, generate
+    from ipex_llm_tpu.ops import dispatch
+    from ipex_llm_tpu.ops.pallas import paged_attention
+    from ipex_llm_tpu.serving.engine import (
+        EngineConfig,
+        Request,
+        ServingEngine,
+        stream_tokens,
+    )
+    from tests.test_decoder import rand_params, tiny_cfg
+
+    cfg = tiny_cfg(vocab_size=101, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    params = rand_params(cfg, qtype="bf16")
+    prompt = list(np.random.default_rng(4).integers(0, 101, 11))
+    # oracle BEFORE enabling pallas: the plain jnp reference path
+    want = generate(cfg, params, [prompt],
+                    GenerationConfig(max_new_tokens=6, do_sample=False))
+    want_toks = list(want.sequences[0, len(prompt):len(prompt) + 6])
+
+    calls = {"n": 0}
+    real = paged_attention.paged_decode_sdpa
+
+    def counted(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(paged_attention, "paged_decode_sdpa", counted)
+    monkeypatch.setenv("IPEX_LLM_TPU_FORCE_PALLAS", "1")
+    dispatch.clear_cache()
+    try:
+        eng = ServingEngine(cfg, params,
+                            EngineConfig(max_rows=2, max_seq_len=128,
+                                         page_size=32, prefill_bucket=32)
+                            ).start()
+        try:
+            req = eng.submit(Request(prompt_ids=prompt, max_new_tokens=6))
+            got = list(stream_tokens(req, timeout=300))
+        finally:
+            eng.stop()
+        assert got == want_toks, (got, want_toks)
+        # the kernel must actually have served the decode steps — a silent
+        # fall-through to the gather path would pass the output check
+        assert calls["n"] > 0
+    finally:
+        monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
+        dispatch.clear_cache()
