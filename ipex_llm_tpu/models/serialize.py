@@ -77,8 +77,10 @@ def unflatten_params(
     qpaths = manifest["qtensors"]
     done = set()
     for name, arr in tensors.items():
-        if ".q." in name:
-            base = name.split(".q.")[0]
+        if name.endswith((".q.data", ".q.scales", ".q.zeros")):
+            # rsplit: a param key literally named "q" (e.g. a vision
+            # tower's q projection) contains ".q." itself
+            base = name.rsplit(".q.", 1)[0]
             if base in done:
                 continue
             done.add(base)

@@ -1,0 +1,190 @@
+"""InternViT vision tower + pixel-shuffle projector (InternVL family).
+
+Reference counterpart: transformers/models/internvl.py patches over HF's
+InternVLVisionModel.  TPU-first shape choices mirror models/vision.py: the
+stride==kernel Conv2d patch stem runs as a matmul, blocks scan as one
+compiled body, layer-scale lambdas stay fp32, projections quantize.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class InternVLVisionConfig:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: tuple[int, int]
+    image_size: tuple[int, int]
+    text_hidden: int = 0           # filled by the projector weights
+    norm_eps: float = 1e-6
+    act: str = "gelu"
+    downsample: float = 0.5
+    projector_act: str = "gelu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf(cls, v: dict, downsample: float = 0.5,
+                projector_act: str = "gelu") -> "InternVLVisionConfig":
+        ps = v.get("patch_size", [14, 14])
+        ims = v.get("image_size", [448, 448])
+        if not isinstance(ps, (list, tuple)):
+            ps = [ps, ps]
+        if not isinstance(ims, (list, tuple)):
+            ims = [ims, ims]
+        if v.get("use_qk_norm"):
+            raise NotImplementedError("InternViT use_qk_norm unsupported")
+        return cls(
+            hidden_size=v["hidden_size"],
+            num_layers=v["num_hidden_layers"],
+            num_heads=v["num_attention_heads"],
+            intermediate_size=v["intermediate_size"],
+            patch_size=(ps[0], ps[1]), image_size=(ims[0], ims[1]),
+            norm_eps=v.get("layer_norm_eps", 1e-6),
+            act=v.get("hidden_act", "gelu"),
+            downsample=downsample, projector_act=projector_act,
+        )
+
+
+def build_internvl_vision_params(vc: InternVLVisionConfig, get, has,
+                                 qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    vt, mp = "model.vision_tower.", "model.multi_modal_projector."
+    if not has(vt + "embeddings.cls_token"):      # legacy submodel prefixes
+        vt, mp = "vision_tower.", "multi_modal_projector."
+    if not has(vt + "embeddings.cls_token"):
+        raise ValueError("no InternViT weights found in checkpoint")
+
+    def gb(lp, key, n):
+        if has(n):
+            lp[key] = jnp.asarray(get(n), jnp.float32)
+
+    p: dict[str, Any] = {}
+    pw = get(vt + "embeddings.patch_embeddings.projection.weight")
+    p["patch_proj"] = quantize_weight(
+        np.ascontiguousarray(pw.reshape(pw.shape[0], -1)), qtype
+    )
+    gb(p, "patch_bias", vt + "embeddings.patch_embeddings.projection.bias")
+    p["cls_token"] = jnp.asarray(get(vt + "embeddings.cls_token"),
+                                 jnp.float32).reshape(1, -1)
+    if has(vt + "embeddings.position_embeddings"):
+        p["pos"] = jnp.asarray(get(vt + "embeddings.position_embeddings"),
+                               jnp.float32)[0]
+    layers = []
+    for i in range(vc.num_layers):
+        b = f"{vt}encoder.layer.{i}."
+        lp: dict[str, Any] = {}
+        for key, n in (("ln1", "layernorm_before"), ("ln2", "layernorm_after")):
+            lp[key] = jnp.asarray(get(b + n + ".weight"), jnp.float32)
+            gb(lp, key + "_b", b + n + ".bias")
+        for key, n in (("q", "attention.q_proj"), ("k", "attention.k_proj"),
+                       ("v", "attention.v_proj"),
+                       ("o", "attention.projection_layer"),
+                       ("fc1", "mlp.fc1"), ("fc2", "mlp.fc2")):
+            lp[key] = quantize_weight(get(b + n + ".weight"), qtype)
+            gb(lp, key + "_b", b + n + ".bias")
+        lp["lambda1"] = jnp.asarray(get(b + "lambda_1"), jnp.float32)
+        lp["lambda2"] = jnp.asarray(get(b + "lambda_2"), jnp.float32)
+        layers.append(lp)
+    p["blocks"] = stack_layer_trees(layers)
+    # final encoder layernorm exists only for non-mean-pooling variants
+    if has(vt + "layernorm.weight"):
+        p["final_ln"] = jnp.asarray(get(vt + "layernorm.weight"), jnp.float32)
+        gb(p, "final_ln_b", vt + "layernorm.bias")
+
+    p["proj_ln"] = jnp.asarray(get(mp + "layer_norm.weight"), jnp.float32)
+    p["proj_ln_b"] = jnp.asarray(get(mp + "layer_norm.bias"), jnp.float32)
+    p["proj_fc1"] = quantize_weight(get(mp + "linear_1.weight"), qtype)
+    p["proj_fc1_b"] = jnp.asarray(get(mp + "linear_1.bias"), jnp.float32)
+    p["proj_fc2"] = quantize_weight(get(mp + "linear_2.weight"), qtype)
+    p["proj_fc2_b"] = jnp.asarray(get(mp + "linear_2.bias"), jnp.float32)
+    return p
+
+
+@partial(jax.jit, static_argnames=("vc",))
+def internvl_vision_forward(vc: InternVLVisionConfig, params: dict,
+                            pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels [B, C, H, W] -> projected image tokens [B, N', text_hidden]."""
+    b, c, hh, ww = pixels.shape
+    ph, pw = vc.patch_size
+    gh, gw = hh // ph, ww // pw
+    # stride==kernel conv as matmul: patch rows ordered (C, ph, pw)
+    patches = pixels.reshape(b, c, gh, ph, gw, pw).transpose(0, 2, 4, 1, 3, 5)
+    patches = patches.reshape(b, gh * gw, c * ph * pw).astype(jnp.bfloat16)
+    x = linear_ops.linear(patches, params["patch_proj"],
+                          params.get("patch_bias")).astype(jnp.float32)
+    cls = jnp.broadcast_to(params["cls_token"][None],
+                           (b, 1, vc.hidden_size))
+    x = jnp.concatenate([cls, x], axis=1)
+    if "pos" in params:
+        x = x + params["pos"][None]
+    n = x.shape[1]
+
+    def block(x, lp):
+        h = layer_norm(x, lp["ln1"], lp.get("ln1_b"), vc.norm_eps)
+        hb = h.astype(jnp.bfloat16)
+        q = linear_ops.linear(hb, lp["q"], lp.get("q_b"))
+        k = linear_ops.linear(hb, lp["k"], lp.get("k_b"))
+        v = linear_ops.linear(hb, lp["v"], lp.get("v_b"))
+        from ipex_llm_tpu.ops.attention import sdpa_reference
+
+        attn = sdpa_reference(
+            q.reshape(b, n, vc.num_heads, vc.head_dim),
+            k.reshape(b, n, vc.num_heads, vc.head_dim),
+            v.reshape(b, n, vc.num_heads, vc.head_dim),
+            causal=False,
+        ).reshape(b, n, vc.hidden_size)
+        o = linear_ops.linear(attn, lp["o"], lp.get("o_b")).astype(jnp.float32)
+        x = x + lp["lambda1"] * o
+        h2 = layer_norm(x, lp["ln2"], lp.get("ln2_b"), vc.norm_eps)
+        inner = mlp_ops.act(
+            linear_ops.linear(h2.astype(jnp.bfloat16), lp["fc1"],
+                              lp.get("fc1_b")), vc.act,
+        )
+        mo = linear_ops.linear(inner, lp["fc2"], lp.get("fc2_b")
+                               ).astype(jnp.float32)
+        x = x + lp["lambda2"] * mo
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    if "final_ln" in params:
+        x = layer_norm(x, params["final_ln"], params.get("final_ln_b"),
+                       vc.norm_eps)
+
+    feats = x[:, 1:]                         # drop cls (default strategy)
+    f = gh                                   # square feature grid
+    ch = vc.hidden_size
+    s = vc.downsample
+    # HF pixel_shuffle (internvl.py:688): [B, w, h*s, c/s] -> permute ->
+    # [B, h*s, w*s, c/s^2] -> permute
+    v4 = feats.reshape(b, f, f, ch)
+    v4 = v4.reshape(b, f, int(f * s), int(ch / s))
+    v4 = v4.transpose(0, 2, 1, 3)
+    v4 = v4.reshape(b, int(f * s), int(f * s), int(ch / (s * s)))
+    v4 = v4.transpose(0, 2, 1, 3)
+    v4 = v4.reshape(b, -1, int(ch / (s * s)))
+
+    h = layer_norm(v4, params["proj_ln"], params["proj_ln_b"], 1e-5)
+    h = mlp_ops.act(
+        linear_ops.linear(h.astype(jnp.bfloat16), params["proj_fc1"],
+                          params["proj_fc1_b"]), vc.projector_act,
+    )
+    out = linear_ops.linear(h, params["proj_fc2"], params["proj_fc2_b"])
+    return out

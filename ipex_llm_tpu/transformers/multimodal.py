@@ -59,14 +59,16 @@ class _AliasReader:
     def _resolve(self, name: str) -> str:
         if self.reader.has(name):
             return name
+        cands = []
         if name.startswith("model."):
-            alt = "model.language_model." + name[len("model."):]
+            suffix = name[len("model."):]
+            cands += ["model.language_model." + suffix,   # 4.52+ nested
+                      "language_model.model." + suffix]   # legacy submodel
+        if name == "lm_head.weight":
+            cands += ["model.lm_head.weight", "language_model.lm_head.weight"]
+        for alt in cands:
             if self.reader.has(alt):
                 return alt
-        if name == "lm_head.weight":
-            for alt in ("model.lm_head.weight",):
-                if self.reader.has(alt):
-                    return alt
         return name
 
     def get(self, name: str):
@@ -237,33 +239,205 @@ class TPUModelForVision2Seq:
         n_p = len(ids)
         x = self._embed_multimodal(ids, pixel_values, image_grid_thw)
         pos, delta = self.get_rope_index(ids, list(image_grid_thw))
+        # text continuation: all three channels advance together from the
+        # multimodal position max (rope_delta), not the slot index
+        return _greedy_generate(
+            self, ids, x, jnp.asarray(pos[None]),
+            lambda step: jnp.full((1, 3, 1), n_p + step + delta, jnp.int32),
+            max_new_tokens,
+        )
+
+
+def _eos_set(hf_config: dict) -> set:
+    """EOS ids from the top-level config or (composite multimodal configs)
+    the nested text_config."""
+    eos = hf_config.get("eos_token_id")
+    if eos is None:
+        eos = (hf_config.get("text_config") or {}).get("eos_token_id")
+    if eos is None:
+        return set()
+    return set(eos) if isinstance(eos, (list, tuple)) else {eos}
+
+
+def _greedy_generate(model, ids, embeds, prefill_pos, step_pos,
+                     max_new_tokens: int):
+    """Shared image+text greedy loop (qwen2-vl / internvl): jitted prefill
+    with spliced embeddings, then jitted single-token steps whose rope
+    positions come from ``step_pos(step)``."""
+    from ipex_llm_tpu import kv as kv_mod
+
+    n_p = len(ids)
+    cache = kv_mod.make_cache(
+        "normal", model.config.num_layers, 1, n_p + max_new_tokens,
+        model.config.num_kv_heads, model.config.head_dim,
+        v_head_dim=model.config.v_dim,
+    )
+    logits, cache = _mm_prefill(
+        model.config, model.params, cache, jnp.asarray(ids[None]),
+        prefill_pos, embeds,
+    )
+    out = list(ids)
+    eos = _eos_set(model.hf_config)
+    tok = int(jnp.argmax(logits[0]))
+    for step in range(max_new_tokens):
+        out.append(tok)
+        if tok in eos:
+            break
+        logits, cache = _mm_decode(
+            model.config, model.params, cache,
+            jnp.asarray([[tok]], jnp.int32), step_pos(step),
+        )
+        tok = int(jnp.argmax(logits[0, -1]))
+    return np.asarray(out, np.int32)[None]
+
+
+class TPUInternVLForConditionalGeneration:
+    """InternVL: InternViT tower + pixel-shuffle projector + qwen2 text.
+
+    Reference counterpart: transformers/models/internvl.py patches.  The
+    text side reuses the shared decoder through the SAME jitted
+    prefill/decode steps as qwen2-vl (plain rope — no M-ROPE)."""
+
+    def __init__(self, cfg: ModelConfig, vcfg, params: dict, vparams: dict,
+                 hf_config: dict, qtype: str):
+        self.config = cfg
+        self.vision_config = vcfg
+        self.params = params
+        self.vision_params = vparams
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.image_token_id = hf_config.get("image_token_id", 151667)
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_internvl import (
+            InternVLVisionConfig,
+            build_internvl_vision_params,
+        )
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        text = dict(hf_config["text_config"])
+        fam = get_family(text.get("model_type", "qwen2"))
+        cfg = fam.to_config(text)
+        vcfg = InternVLVisionConfig.from_hf(
+            hf_config["vision_config"],
+            downsample=hf_config.get("downsample_ratio", 0.5),
+            projector_act=hf_config.get("projector_hidden_act", "gelu"),
+        )
+        reader = _AliasReader(CheckpointReader(path))
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_internvl_vision_params(
+            vcfg, reader.reader.get, reader.reader.has, qtype
+        )
+        return cls(cfg, vcfg, params, vparams, hf_config, qtype)
+
+    def _embed_multimodal(self, ids: np.ndarray, pixel_values):
+        from ipex_llm_tpu.models.vision_internvl import internvl_vision_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
+        if pixel_values is not None:
+            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            img = internvl_vision_forward(
+                self.vision_config, self.vision_params, px
+            ).reshape(-1, x.shape[-1]).astype(x.dtype)
+            (idx,) = np.nonzero(np.asarray(ids) == self.image_token_id)
+            assert len(idx) == img.shape[0], (
+                f"{len(idx)} image tokens vs {img.shape[0]} image embeds"
+            )
+            x = x.at[0, jnp.asarray(idx)].set(img)
+        return x
+
+    def forward_logits(self, input_ids, pixel_values=None):
+        from ipex_llm_tpu import kv as kv_mod
+        from ipex_llm_tpu.models.decoder import decoder_forward
+
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        x = self._embed_multimodal(ids, pixel_values)
         cache = kv_mod.make_cache(
-            "normal", self.config.num_layers, 1, n_p + max_new_tokens,
+            "normal", self.config.num_layers, 1, len(ids),
             self.config.num_kv_heads, self.config.head_dim,
             v_head_dim=self.config.v_dim,
         )
-        logits, cache = _mm_prefill(
-            self.config, self.params, cache, jnp.asarray(ids[None]),
-            jnp.asarray(pos[None]), x,
+        pos = jnp.arange(len(ids))[None, :]
+        logits, _ = decoder_forward(
+            self.config, self.params, jnp.asarray(ids[None]), cache, pos,
+            input_embeds=x,
         )
-        out = list(ids)
-        eos = self.hf_config.get("eos_token_id")
-        eos = set(eos) if isinstance(eos, list) else {eos}
-        tok = int(jnp.argmax(logits[0]))
-        for step in range(max_new_tokens):
-            out.append(tok)
-            if tok in eos:
-                break
-            # text continuation: all three channels advance together from
-            # the multimodal position max (rope_delta), not the slot index
-            p = n_p + step + delta
-            logits, cache = _mm_decode(
-                self.config, self.params, cache,
-                jnp.asarray([[tok]], jnp.int32),
-                jnp.full((1, 3, 1), p, jnp.int32),
+        return logits
+
+    def generate(self, input_ids, pixel_values=None, max_new_tokens: int = 32,
+                 **kwargs):
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        n_p = len(ids)
+        x = self._embed_multimodal(ids, pixel_values)
+        return _greedy_generate(
+            self, ids, x, jnp.arange(n_p)[None, :],
+            lambda step: jnp.asarray([[n_p + step]], jnp.int32),
+            max_new_tokens,
+        )
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(
+            path, {"text": self.params, "vision": self.vision_params},
+            self.hf_config, self.qtype,
+        )
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_internvl import InternVLVisionConfig
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        text = dict(hf["text_config"])
+        cfg = get_family(text.get("model_type", "qwen2")).to_config(text)
+        vcfg = InternVLVisionConfig.from_hf(
+            hf["vision_config"],
+            downsample=hf.get("downsample_ratio", 0.5),
+            projector_act=hf.get("projector_hidden_act", "gelu"),
+        )
+        return cls(cfg, vcfg, tree["text"], tree["vision"], hf, qtype)
+
+
+class AutoModelForVision2Seq:
+    """Vision-language loader dispatching by model_type (qwen2_vl,
+    internvl)."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        mt = read_config(str(path)).get("model_type")
+        if mt == "qwen2_vl":
+            return TPUModelForVision2Seq.from_pretrained(str(path), **kwargs)
+        if mt == "internvl":
+            return TPUInternVLForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
             )
-            tok = int(jnp.argmax(logits[0, -1]))
-        return np.asarray(out, np.int32)[None]
+        raise ValueError(
+            f"AutoModelForVision2Seq supports qwen2_vl/internvl; got {mt!r}"
+        )
 
+    @classmethod
+    def load_low_bit(cls, path: str):
+        import json
+        import os
 
-AutoModelForVision2Seq = TPUModelForVision2Seq
+        # dispatch from config.json alone — never deserialize the weight
+        # tree twice
+        with open(os.path.join(str(path), "config.json")) as f:
+            mt = json.load(f).get("model_type")
+        if mt == "qwen2_vl":
+            return TPUModelForVision2Seq.load_low_bit(str(path))
+        if mt == "internvl":
+            return TPUInternVLForConditionalGeneration.load_low_bit(str(path))
+        raise ValueError(
+            f"load_low_bit supports qwen2_vl/internvl; got {mt!r}"
+        )

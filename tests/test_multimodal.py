@@ -198,6 +198,21 @@ def test_multimodal_save_load_low_bit(tiny_qwen2vl, tiny_whisper, tmp_path):
     assert (want_w == got_w).all()
 
 
+def test_internvl_save_load_low_bit(tiny_internvl, tmp_path):
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    _, path = tiny_internvl
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="sym_int4")
+    rng = np.random.default_rng(12)
+    pixels = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    ids = np.asarray([5, 9, 3] + [150] * 4 + [7, 11], np.int32)
+    want = m.generate(ids, pixels, max_new_tokens=4)
+    m.save_low_bit(str(tmp_path / "ivl"))
+    m2 = AutoModelForVision2Seq.load_low_bit(str(tmp_path / "ivl"))
+    got = m2.generate(ids, pixels, max_new_tokens=4)
+    assert (want == got).all()
+
+
 # ---------------------------------------------------------------------------
 # rwkv4 (recurrent family) — reference transformers/models/rwkv4.py
 # ---------------------------------------------------------------------------
@@ -233,3 +248,70 @@ def test_rwkv_logits_and_state_decode(tmp_path):
     got_gen = m.generate(ids[0].astype(np.int32), max_new_tokens=6)
     got_gen = got_gen[0, ids.shape[1]:]
     assert (got_gen[:5] == want_gen[:5]).all(), (got_gen, want_gen)
+
+
+# ---------------------------------------------------------------------------
+# internvl (InternViT + pixel-shuffle projector + qwen2 text)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_internvl(tmp_path_factory):
+    from transformers import InternVLConfig, InternVLForConditionalGeneration
+
+    cfg = InternVLConfig(
+        text_config=dict(model_type="qwen2", vocab_size=160, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=256,
+                         tie_word_embeddings=False),
+        vision_config=dict(hidden_size=32, num_hidden_layers=2,
+                           num_attention_heads=2, intermediate_size=64,
+                           patch_size=[4, 4], image_size=[16, 16]),
+        image_token_id=150, image_seq_length=4, downsample_ratio=0.5,
+    )
+    torch.manual_seed(0)
+    model = InternVLForConditionalGeneration(cfg).eval()
+    path = str(tmp_path_factory.mktemp("internvl") / "m")
+    model.save_pretrained(path, safe_serialization=True)
+    return model, path
+
+
+def test_internvl_logits_parity(tiny_internvl):
+    hf, path = tiny_internvl
+    rng = np.random.default_rng(8)
+    # 16x16 image, 4x4 patches -> 4x4 grid -> pixel-shuffle 0.5 -> 4 tokens
+    pixels = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    ids = np.asarray([5, 9, 3] + [150] * 4 + [7, 11], np.int32)
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(ids)[None].long(),
+            pixel_values=torch.from_numpy(pixels),
+        ).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m.forward_logits(ids, pixels))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+
+def test_internvl_generate(tiny_internvl):
+    hf, path = tiny_internvl
+    rng = np.random.default_rng(9)
+    pixels = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    ids = np.asarray([5, 9, 3] + [150] * 4 + [7, 11], np.int32)
+    with torch.no_grad():
+        want = hf.generate(
+            input_ids=torch.from_numpy(ids)[None].long(),
+            pixel_values=torch.from_numpy(pixels),
+            max_new_tokens=6, do_sample=False,
+        )[0, len(ids):].numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    got = m.generate(ids, pixels, max_new_tokens=6)[0, len(ids):]
+    assert (got[:4] == want[:4]).all(), (got, want)
