@@ -69,6 +69,11 @@ class ModelConfig:
     logit_softcap: float | None = None          # gemma2 final logit softcap
     attn_scale: float | None = None             # override 1/sqrt(d)
     embedding_multiplier: float = 1.0           # gemma sqrt(hidden)
+    # minicpm "mup"-style depth scaling: each block's residual contribution
+    # is multiplied by scale_depth/sqrt(num_layers) (reference minicpm.py:58
+    # apply_residual_scale folds it into o_proj/down_proj; here it is a
+    # config knob applied in the decoder so quantized weights stay faithful)
+    residual_multiplier: float = 1.0
 
     # MoE (mixtral / qwen-moe / deepseek-style)
     num_experts: int = 0

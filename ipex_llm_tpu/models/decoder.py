@@ -402,12 +402,19 @@ def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
             sliding, cache, collect_obs, bias=alibi_bias,
         )
         ffn = _moe_block if "moe_gate_up" in lp else _mlp_block
+        # minicpm depth scaling (cfg.residual_multiplier, 1.0 elsewhere)
+        rm = (jnp.asarray(cfg.residual_multiplier, COMPUTE_DTYPE)
+              if cfg.residual_multiplier != 1.0 else None)
+
+        def add(res, out):
+            return res + out if rm is None else res + rm * out
+
         if cfg.parallel_blocks:
             # x + attn(ln(x)) + mlp(ln'(x)) — phi/gpt-neox parallel residual
-            x = x + attn_out + ffn(cfg, lp, x)
+            x = add(x, attn_out + ffn(cfg, lp, x))
         else:
-            x = x + attn_out
-            x = x + ffn(cfg, lp, x)
+            x = add(x, attn_out)
+            x = add(x, ffn(cfg, lp, x))
         return x, (kl, vl, obs_q)
 
     x, (k_new, v_new, obs_q) = jax.lax.scan(

@@ -556,6 +556,93 @@ def _falcon(hf: dict) -> ModelConfig:
     ))
 
 
+def _internlm(hf: dict) -> ModelConfig:
+    """internlm (v1): llama layout with a single ``bias`` flag covering
+    q/k/v/o (reference transformers/models/internlm.py)."""
+    b = hf.get("bias", True)
+    return ModelConfig(**_base_cfg(hf, attention_bias=b,
+                                   attention_out_bias=b))
+
+
+def _qwen(hf: dict) -> ModelConfig:
+    """Qwen (v1, e.g. Qwen-7B/14B): fused ``c_attn`` [q;k;v] with bias,
+    no o/mlp bias, RMSNorm, half-layout full rotary, and a silu-gated MLP
+    where ``intermediate_size`` counts BOTH branches (per-branch ffn dim is
+    intermediate_size//2; reference qwen.py:261 c_proj(silu(w2)·w1))."""
+    head_dim = hf.get("kv_channels",
+                      hf["hidden_size"] // hf["num_attention_heads"])
+    hf2 = dict(
+        model_type="qwen",
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"] // 2,
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        head_dim=head_dim,
+        rms_norm_eps=hf.get("layer_norm_epsilon", 1e-6),
+        max_position_embeddings=hf.get("seq_length", 8192),
+        rope_theta=hf.get("rotary_emb_base", 10000.0),
+    )
+    return ModelConfig(**_base_cfg(
+        hf2, attention_bias=not hf.get("no_bias", False),
+        attention_out_bias=False,
+    ))
+
+
+def _gptbigcode(hf: dict) -> ModelConfig:
+    """gpt_bigcode (starcoder-1/santacoder): gpt2-style learned positions +
+    LayerNorm, non-gated gelu MLP, and MQA (kv_heads=1) via a fused
+    ``c_attn`` that is a straight [q; k; v] concat (reference
+    gptbigcode.py:61-66; the non-MQA variant interleaves per head)."""
+    h = hf["n_embd"]
+    hf2 = dict(
+        model_type="gpt_bigcode", vocab_size=hf["vocab_size"], hidden_size=h,
+        intermediate_size=hf.get("n_inner") or 4 * h,
+        num_hidden_layers=hf["n_layer"],
+        num_attention_heads=hf["n_head"],
+        num_key_value_heads=1 if hf.get("multi_query", True) else hf["n_head"],
+        layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        max_position_embeddings=hf.get("n_positions", 8192),
+    )
+    return ModelConfig(**_base_cfg(
+        hf2, rope=None, learned_pos=hf.get("n_positions", 8192),
+        norm_kind="layer", act=hf.get("activation_function", "gelu_pytorch_tanh"),
+        mlp_gated=False, attention_bias=True, attention_out_bias=True,
+        mlp_bias=True, tie_word_embeddings=True,
+    ))
+
+
+def _minicpm(hf: dict) -> ModelConfig:
+    """minicpm (1/2): llama layout plus muP-style scalings — embeddings
+    × scale_emb, every residual branch × scale_depth/sqrt(L), and logits
+    × dim_model_base/hidden_size (reference minicpm.py:58
+    apply_residual_scale + modeling's hidden/(hidden/dim_model_base))."""
+    return ModelConfig(**_base_cfg(
+        hf,
+        embedding_multiplier=float(hf.get("scale_emb", 1.0)),
+        residual_multiplier=float(hf.get("scale_depth", 1.0))
+        / float(np.sqrt(hf["num_hidden_layers"])),
+        logit_scale=float(hf.get("dim_model_base", hf["hidden_size"]))
+        / hf["hidden_size"],
+    ))
+
+
+def _minicpm3(hf: dict) -> ModelConfig:
+    """minicpm3: DeepSeek-style MLA attention (same q_a/kv_a low-rank
+    weight names) combined with the minicpm muP scalings (reference
+    minicpm3.py; MLA math deepseek.py:274-343)."""
+    d = _deepseek_common(hf)
+    d.update(
+        model_type="minicpm3",
+        embedding_multiplier=float(hf.get("scale_emb", 1.0)),
+        residual_multiplier=float(hf.get("scale_depth", 1.0))
+        / float(np.sqrt(hf["num_hidden_layers"])),
+        logit_scale=float(hf.get("dim_model_base", hf["hidden_size"]))
+        / hf["hidden_size"],
+    )
+    return ModelConfig(**d)
+
+
 def _neox_qkv(w, cfg: ModelConfig):
     """GPT-NeoX query_key_value: per-head [q_i;k_i;v_i] interleave ->
     [q_all; k_all; v_all]."""
@@ -775,6 +862,44 @@ _FALCON_SCHEME = WeightScheme(
     down="transformer.h.{i}.mlp.dense_4h_to_h.{p}",
 )
 
+_QWEN_SCHEME = WeightScheme(
+    embed="transformer.wte.weight",
+    final_norm="transformer.ln_f.weight",
+    lm_head="lm_head.weight",
+    attn_norm="transformer.h.{i}.ln_1.weight",
+    mlp_norm="transformer.h.{i}.ln_2.weight",
+    qkv="transformer.h.{i}.attn.c_attn.{p}",
+    q=None, k=None, v=None,
+    o="transformer.h.{i}.attn.c_proj.{p}",
+    # reference qwen.py:261: c_proj(silu(w2(x)) * w1(x)) → w2 is the gate
+    gate="transformer.h.{i}.mlp.w2.{p}",
+    up="transformer.h.{i}.mlp.w1.{p}",
+    down="transformer.h.{i}.mlp.c_proj.{p}",
+)
+_GPTBIGCODE_SCHEME = WeightScheme(
+    embed="transformer.wte.weight",
+    pos_embed="transformer.wpe.weight",
+    final_norm="transformer.ln_f.weight",
+    lm_head="transformer.wte.weight",
+    attn_norm="transformer.h.{i}.ln_1.weight",
+    mlp_norm="transformer.h.{i}.ln_2.weight",
+    qkv="transformer.h.{i}.attn.c_attn.{p}",
+    q=None, k=None, v=None,
+    o="transformer.h.{i}.attn.c_proj.{p}",
+    gate=None, gate_up=None,
+    up="transformer.h.{i}.mlp.c_fc.{p}",
+    down="transformer.h.{i}.mlp.c_proj.{p}",
+)
+
+
+def _gptbigcode_qkv(w, cfg: ModelConfig):
+    """MQA c_attn is already [q_all; k; v]; the non-MQA variant interleaves
+    per head like gpt-neox (reference gptbigcode.py:66-69)."""
+    if cfg.num_kv_heads == 1:
+        return w
+    return _neox_qkv(w, cfg)
+
+
 _MIXTRAL_MOE = MoEScheme(
     router="model.layers.{i}.block_sparse_moe.gate.weight",
     e_gate="model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
@@ -828,6 +953,15 @@ FAMILIES: dict[str, Family] = {
     "olmo2": Family("olmo2", _olmo2, _OLMO2_SCHEME),
     "falcon": Family("falcon", _falcon, _FALCON_SCHEME,
                      qkv_transform=_falcon_qkv),
+    # aquila (BAAI Aquila/Aquila2) is a faithful llama clone — same config
+    # keys and weight names (reference models/aquila.py patches llama SDPA)
+    "aquila": Family("aquila", _llama),
+    "internlm": Family("internlm", _internlm),
+    "qwen": Family("qwen", _qwen, _QWEN_SCHEME),
+    "gpt_bigcode": Family("gpt_bigcode", _gptbigcode, _GPTBIGCODE_SCHEME,
+                          qkv_transform=_gptbigcode_qkv),
+    "minicpm": Family("minicpm", _minicpm),
+    "minicpm3": Family("minicpm3", _minicpm3, _DEEPSEEK_SCHEME),
     "glm": Family("glm", _glm, _GLM_SCHEME),
     "glm4": Family("glm4", _glm4, _GLM4_SCHEME),
     "chatglm": Family("chatglm", _chatglm, _CHATGLM_SCHEME),

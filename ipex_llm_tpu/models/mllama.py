@@ -1,0 +1,640 @@
+"""MLlama (Llama-3.2-Vision): tiled ViT + cross-attention text decoder.
+
+Reference counterpart: transformers/models/mllama.py (the reference patches
+HF's Mllama SDPA + rms-norm paths).  Unlike the embed-replacement families
+(qwen2-vl / internvl / llava), mllama injects vision through dedicated
+CROSS-ATTENTION decoder layers interleaved with self-attention layers, so
+it gets its own module (like whisper, which shares the same seq2seq
+pattern):
+
+- the vision side is the HF two-stage encoder: per-tile local transformer
+  (with gated aspect-ratio/tile position embeddings) then a global
+  transformer over all tiles, with intermediate layer outputs concatenated
+  into the projector input;
+- the text side runs self-attn layers through the same fused ops as the
+  shared decoder (rope/norms/sdpa) and cross-attn layers against a
+  STATIC vision KV computed once per image — decode steps never re-touch
+  the tower;
+- layers are heterogeneous (self vs cross weights), so the text forward is
+  an unrolled jit loop over per-layer trees rather than a lax.scan — the
+  compiled graph is identical, only trace time differs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.ops.norms import layer_norm, rms_norm
+from ipex_llm_tpu.ops.rope import RopeScaling, apply_rope, cos_sin
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MllamaVisionCfg:
+    hidden_size: int
+    num_layers: int
+    num_global_layers: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: int
+    image_size: int
+    max_num_tiles: int
+    intermediate_layers_indices: tuple[int, ...]
+    norm_eps: float = 1e-5
+    act: str = "gelu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2 + 1
+
+    @classmethod
+    def from_hf(cls, v: dict) -> "MllamaVisionCfg":
+        return cls(
+            hidden_size=v["hidden_size"],
+            num_layers=v["num_hidden_layers"],
+            num_global_layers=v.get("num_global_layers", 8),
+            # HF serializes this as "attention_heads" (MllamaVisionConfig)
+            num_heads=v.get("attention_heads", v.get("num_attention_heads")),
+            intermediate_size=v["intermediate_size"],
+            patch_size=v.get("patch_size", 14),
+            image_size=v.get("image_size", 448),
+            max_num_tiles=v.get("max_num_tiles", 4),
+            intermediate_layers_indices=tuple(
+                v.get("intermediate_layers_indices", (3, 7, 15, 23, 30))),
+            norm_eps=v.get("norm_eps", 1e-5),
+            act=v.get("hidden_act", "gelu"),
+        )
+
+
+@dataclass(frozen=True)
+class MllamaTextCfg:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    cross_attention_layers: tuple[int, ...]
+    max_position_embeddings: int = 131072
+    norm_eps: float = 1e-5
+    act: str = "silu"
+    rope: RopeScaling | None = None
+    eos_token_id: Any = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf(cls, t: dict) -> "MllamaTextCfg":
+        head_dim = t["hidden_size"] // t["num_attention_heads"]
+        rs = t.get("rope_scaling") or {}
+        rope = RopeScaling(
+            head_dim=head_dim,
+            base=t.get("rope_theta", 500000.0),
+            kind=rs.get("rope_type", rs.get("type", "default")),
+            factor=rs.get("factor", 1.0),
+            low_freq_factor=rs.get("low_freq_factor", 1.0),
+            high_freq_factor=rs.get("high_freq_factor", 4.0),
+            original_max_position=rs.get("original_max_position_embeddings",
+                                         8192),
+        )
+        return cls(
+            vocab_size=t["vocab_size"],
+            hidden_size=t["hidden_size"],
+            intermediate_size=t["intermediate_size"],
+            num_layers=t["num_hidden_layers"],
+            num_heads=t["num_attention_heads"],
+            num_kv_heads=t.get("num_key_value_heads",
+                               t["num_attention_heads"]),
+            cross_attention_layers=tuple(t.get("cross_attention_layers", ())),
+            max_position_embeddings=t.get("max_position_embeddings", 131072),
+            norm_eps=t.get("rms_norm_eps", 1e-5),
+            act=t.get("hidden_act", "silu"),
+            rope=rope,
+            eos_token_id=t.get("eos_token_id", 2),
+        )
+
+
+# ---------------------------------------------------------------------------
+# weights
+# ---------------------------------------------------------------------------
+
+
+def _pfx(has) -> tuple[str, str, str]:
+    vm, lm, mp = ("model.vision_model.", "model.language_model.",
+                  "model.multi_modal_projector.")
+    if not has(vm + "class_embedding"):
+        vm, lm, mp = ("vision_model.", "language_model.model.",
+                      "multi_modal_projector.")
+    if not has(vm + "class_embedding"):
+        raise ValueError("no mllama vision weights found in checkpoint")
+    return vm, lm, mp
+
+
+def build_mllama_params(vc: MllamaVisionCfg, tc: MllamaTextCfg, get, has,
+                        qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    vm, lm, mp = _pfx(has)
+
+    def f32(n):
+        return jnp.asarray(get(n), jnp.float32)
+
+    def ln(name):
+        return {"w": f32(name + ".weight"), "b": f32(name + ".bias")}
+
+    p: dict[str, Any] = {}
+    # -- vision tower -------------------------------------------------------
+    pw = get(vm + "patch_embedding.weight")
+    v: dict[str, Any] = {
+        "patch_proj": quantize_weight(
+            np.ascontiguousarray(pw.reshape(pw.shape[0], -1)), qtype),
+        "cls": f32(vm + "class_embedding"),
+        "pos_gate": f32(vm + "gated_positional_embedding.gate"),
+        "pos": f32(vm + "gated_positional_embedding.embedding"),
+        "tile_pos": f32(vm + "gated_positional_embedding.tile_embedding.weight"),
+        "pre_tile_gate": f32(vm + "pre_tile_positional_embedding.gate"),
+        "pre_tile": f32(vm + "pre_tile_positional_embedding.embedding.weight"),
+        "post_tile_gate": f32(vm + "post_tile_positional_embedding.gate"),
+        "post_tile": f32(vm + "post_tile_positional_embedding.embedding.weight"),
+        "ln_pre": ln(vm + "layernorm_pre"),
+        "ln_post": ln(vm + "layernorm_post"),
+    }
+
+    def enc_layer(b, gated):
+        lp = {
+            "ln1": ln(b + "input_layernorm"),
+            "ln2": ln(b + "post_attention_layernorm"),
+            "q": quantize_weight(get(b + "self_attn.q_proj.weight"), qtype),
+            "k": quantize_weight(get(b + "self_attn.k_proj.weight"), qtype),
+            "v": quantize_weight(get(b + "self_attn.v_proj.weight"), qtype),
+            "o": quantize_weight(get(b + "self_attn.o_proj.weight"), qtype),
+            "fc1": quantize_weight(get(b + "mlp.fc1.weight"), qtype),
+            "fc1_b": f32(b + "mlp.fc1.bias"),
+            "fc2": quantize_weight(get(b + "mlp.fc2.weight"), qtype),
+            "fc2_b": f32(b + "mlp.fc2.bias"),
+        }
+        if gated:
+            lp["gate_attn"] = f32(b + "gate_attn")
+            lp["gate_ffn"] = f32(b + "gate_ffn")
+        return lp
+
+    # string-keyed dicts (not lists) so the low-bit serializer's dict
+    # walker (models/serialize.py:_walk) round-trips the tree unchanged
+    v["local"] = {str(i): enc_layer(f"{vm}transformer.layers.{i}.", False)
+                  for i in range(vc.num_layers)}
+    v["global"] = stack_layer_trees(
+        [enc_layer(f"{vm}global_transformer.layers.{i}.", True)
+         for i in range(vc.num_global_layers)])
+    p["vision"] = v
+
+    p["proj"] = quantize_weight(get(mp + "weight"), qtype)
+    p["proj_b"] = f32(mp + "bias")
+
+    # -- text decoder -------------------------------------------------------
+    embed_w = get(lm + "embed_tokens.weight")
+    # the head may sit at top level ("lm_head.weight") or under the legacy
+    # submodel prefix ("language_model.lm_head.weight"); tied checkpoints
+    # omit it entirely, and then it is the first vocab_size rows of the
+    # embedding (which holds vocab_size + 8 special rows)
+    head_w = None
+    for name in ("lm_head.weight", "language_model.lm_head.weight",
+                 "model.lm_head.weight"):
+        if has(name):
+            head_w = get(name)
+            break
+    if head_w is None:
+        head_w = np.ascontiguousarray(embed_w[: tc.vocab_size])
+    t: dict[str, Any] = {
+        "embed": jnp.asarray(embed_w, jnp.bfloat16),
+        "final_norm": f32(lm + "norm.weight"),
+        "lm_head": quantize_weight(head_w, qtype),
+    }
+    layers = []
+    for i in range(tc.num_layers):
+        b = f"{lm}layers.{i}."
+        lp = {
+            "attn_norm": f32(b + "input_layernorm.weight"),
+            "mlp_norm": f32(b + "post_attention_layernorm.weight"),
+            "gate": quantize_weight(get(b + "mlp.gate_proj.weight"), qtype),
+            "up": quantize_weight(get(b + "mlp.up_proj.weight"), qtype),
+            "down": quantize_weight(get(b + "mlp.down_proj.weight"), qtype),
+        }
+        if i in tc.cross_attention_layers:
+            a = b + "cross_attn."
+            lp.update(
+                q=quantize_weight(get(a + "q_proj.weight"), qtype),
+                k=quantize_weight(get(a + "k_proj.weight"), qtype),
+                v=quantize_weight(get(a + "v_proj.weight"), qtype),
+                o=quantize_weight(get(a + "o_proj.weight"), qtype),
+                q_norm=f32(a + "q_norm.weight"),
+                k_norm=f32(a + "k_norm.weight"),
+                attn_gate=f32(b + "cross_attn_attn_gate"),
+                mlp_gate=f32(b + "cross_attn_mlp_gate"),
+            )
+        else:
+            a = b + "self_attn."
+            lp.update(
+                q=quantize_weight(get(a + "q_proj.weight"), qtype),
+                k=quantize_weight(get(a + "k_proj.weight"), qtype),
+                v=quantize_weight(get(a + "v_proj.weight"), qtype),
+                o=quantize_weight(get(a + "o_proj.weight"), qtype),
+            )
+        layers.append(lp)
+    t["layers"] = {str(i): lp for i, lp in enumerate(layers)}
+    t["inv_freq"] = jnp.asarray(tc.rope.inv_freq(), jnp.float32)
+    p["text"] = t
+    return p
+
+
+# ---------------------------------------------------------------------------
+# vision forward
+# ---------------------------------------------------------------------------
+
+
+def _vit_block(vc: MllamaVisionCfg, lp, x, mask_bias):
+    b, n, d = x.shape
+    h = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"], vc.norm_eps)
+    hb = h.astype(jnp.bfloat16)
+    q = linear_ops.linear(hb, lp["q"]).reshape(b, n, vc.num_heads, vc.head_dim)
+    k = linear_ops.linear(hb, lp["k"]).reshape(b, n, vc.num_heads, vc.head_dim)
+    vv = linear_ops.linear(hb, lp["v"]).reshape(b, n, vc.num_heads, vc.head_dim)
+    attn = sdpa_reference(q, k, vv, causal=False, bias=mask_bias
+                          ).reshape(b, n, d)
+    o = linear_ops.linear(attn, lp["o"]).astype(jnp.float32)
+    if "gate_attn" in lp:
+        o = jnp.tanh(lp["gate_attn"]) * o
+    x = x + o
+    h2 = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"], vc.norm_eps)
+    inner = mlp_ops.act(
+        linear_ops.linear(h2.astype(jnp.bfloat16), lp["fc1"], lp["fc1_b"]),
+        vc.act)
+    mo = linear_ops.linear(inner, lp["fc2"], lp["fc2_b"]).astype(jnp.float32)
+    if "gate_ffn" in lp:
+        mo = jnp.tanh(lp["gate_ffn"]) * mo
+    return x + mo
+
+
+@partial(jax.jit, static_argnames=("vc",))
+def mllama_vision_forward(vc: MllamaVisionCfg, v: dict, pixels: jnp.ndarray,
+                          aspect_ratio_id: jnp.ndarray,
+                          tile_mask: jnp.ndarray) -> jnp.ndarray:
+    """pixels [T_tiles, C, H, W] (one image), aspect_ratio_id scalar,
+    tile_mask [T_tiles] bool -> features [T_tiles*num_patches, out_dim]
+    where out_dim = hidden * (1 + n_intermediate)."""
+    nt, c, hh, ww = pixels.shape
+    ps = vc.patch_size
+    gh, gw = hh // ps, ww // ps
+    npatch = gh * gw
+    d = vc.hidden_size
+
+    patches = pixels.reshape(nt, c, gh, ps, gw, ps).transpose(0, 2, 4, 1, 3, 5)
+    patches = patches.reshape(nt, npatch, c * ps * ps).astype(jnp.bfloat16)
+    x = linear_ops.linear(patches, v["patch_proj"]).astype(jnp.float32)
+
+    # gated pre-tile embedding [max_tiles, d] slice for this aspect ratio
+    pre = v["pre_tile"][aspect_ratio_id].reshape(vc.max_num_tiles, 1, d)
+    x = x + jnp.tanh(v["pre_tile_gate"]) * pre[:nt]
+
+    cls = jnp.broadcast_to(v["cls"][None, None], (nt, 1, d))
+    x = jnp.concatenate([cls, x], axis=1)          # [nt, np+1, d]
+    n1 = npatch + 1
+
+    # gated positional embeddings (shared + per-tile table)
+    x = x + (1 - jnp.tanh(v["pos_gate"])) * v["pos"][None]
+    tile_pos = v["tile_pos"][aspect_ratio_id].reshape(
+        vc.max_num_tiles, vc.num_patches, d)
+    x = x + jnp.tanh(v["pos_gate"]) * tile_pos[:nt]
+
+    x = layer_norm(x, v["ln_pre"]["w"], v["ln_pre"]["b"], vc.norm_eps)
+
+    # one attention segment over all tiles; masked tiles contribute nothing
+    x = x.reshape(1, nt * n1, d)
+    token_ok = jnp.repeat(tile_mask.astype(jnp.float32), n1)
+    mask_bias = jnp.where(token_ok > 0, 0.0, -1e9)[None, None, None, :]
+
+    inters = []
+    n_local = vc.num_layers
+    for i in range(n_local):
+        lp = v["local"][str(i)]
+        if i in vc.intermediate_layers_indices:
+            inters.append(x)
+        x = _vit_block(vc, lp, x, mask_bias)
+        if i + 1 == n_local and (i + 1) in vc.intermediate_layers_indices:
+            inters.append(x)
+    # HF collects hidden_states[i] = INPUT of layer i; indices beyond depth
+    # resolve to the final output which we appended above when configured.
+
+    x = layer_norm(x, v["ln_post"]["w"], v["ln_post"]["b"], vc.norm_eps)
+
+    post = v["post_tile"][aspect_ratio_id].reshape(vc.max_num_tiles, 1, d)
+    x = x.reshape(nt, n1, d) + jnp.tanh(v["post_tile_gate"]) * post[:nt]
+    x = x.reshape(1, nt * n1, d)
+
+    def gblock(x, lp):
+        return _vit_block(vc, lp, x, mask_bias), None
+
+    x, _ = jax.lax.scan(gblock, x, v["global"])
+
+    # HF stacks the k intermediate states on a trailing axis then flattens,
+    # so their channels interleave [d, k]-major before the concat
+    inter = jnp.stack(inters, axis=-1).reshape(x.shape[:2] + (-1,))
+    feats = jnp.concatenate([x, inter], axis=-1)   # [1, nt*n1, d*(1+k)]
+    return feats[0]
+
+
+# ---------------------------------------------------------------------------
+# text forward
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("tc",))
+def mllama_text_forward(tc: MllamaTextCfg, t: dict, tokens: jnp.ndarray,
+                        cross_feats, kv, pos0: jnp.ndarray,
+                        cross_kv: dict | None = None,
+                        cross_bias=None, row_mask=None):
+    """tokens [B,T]; cross_feats [B, Nv, hidden] projected vision tokens (or
+    None for text-only); kv: dict of per-self-layer (k, v) cache arrays
+    [B, S, Hkv, hd]; pos0 scalar start position.
+
+    ``cross_bias`` [B,1,T,Nv] is the prepared additive cross-attention mask
+    and ``row_mask`` [B,T,1] the full-text-row mask applied to the cross
+    layers' MLP output (HF modeling_mllama.py:_prepare_cross_attention_mask
+    semantics: fully-masked rows attend uniformly but their MLP contribution
+    is zeroed).
+
+    Returns (logits [B,T,V], kv, cross_kv).  With no vision input at all,
+    cross layers are skipped whole — attention AND gated MLP — matching HF
+    (modeling_mllama.py:1256 ``continue`` on the text-only path)."""
+    b, tt = tokens.shape
+    hd = tc.head_dim
+    x = t["embed"][tokens].astype(jnp.float32)
+    pos = pos0 + jnp.arange(tt)[None, :]
+    cos, sin = cos_sin(pos, t["inv_freq"])
+
+    new_kv = {}
+    new_cross = {}
+    for i in range(tc.num_layers):
+        lp = t["layers"][str(i)]
+        if i in tc.cross_attention_layers:
+            have_cached = cross_kv is not None and i in cross_kv
+            if not have_cached and cross_feats is None:
+                continue  # text-only: whole cross layer skipped
+            h = rms_norm(x, lp["attn_norm"], tc.norm_eps)
+            hb = h.astype(jnp.bfloat16)
+            q = linear_ops.linear(hb, lp["q"]).reshape(b, tt, tc.num_heads, hd)
+            q = rms_norm(q, lp["q_norm"], tc.norm_eps)
+            if have_cached:
+                ck, cv = cross_kv[i]
+            else:
+                cf = cross_feats.astype(jnp.bfloat16)
+                nv = cf.shape[1]
+                ck = linear_ops.linear(cf, lp["k"]).reshape(
+                    b, nv, tc.num_kv_heads, hd)
+                ck = rms_norm(ck, lp["k_norm"], tc.norm_eps)
+                cv = linear_ops.linear(cf, lp["v"]).reshape(
+                    b, nv, tc.num_kv_heads, hd)
+            new_cross[i] = (ck, cv)
+            attn = sdpa_reference(q.astype(jnp.bfloat16),
+                                  ck.astype(jnp.bfloat16),
+                                  cv.astype(jnp.bfloat16), causal=False,
+                                  bias=cross_bias)
+            attn_out = linear_ops.linear(
+                attn.reshape(b, tt, tc.num_heads * hd).astype(jnp.bfloat16),
+                lp["o"]).astype(jnp.float32)
+            x = x + jnp.tanh(lp["attn_gate"]) * attn_out
+            h2 = rms_norm(x, lp["mlp_norm"], tc.norm_eps)
+            inner = mlp_ops.gated_act_mul(
+                linear_ops.linear(h2.astype(jnp.bfloat16), lp["gate"]),
+                linear_ops.linear(h2.astype(jnp.bfloat16), lp["up"]), tc.act)
+            mo = linear_ops.linear(inner, lp["down"]).astype(jnp.float32)
+            if row_mask is not None:
+                mo = mo * row_mask
+            x = x + jnp.tanh(lp["mlp_gate"]) * mo
+        else:
+            h = rms_norm(x, lp["attn_norm"], tc.norm_eps)
+            hb = h.astype(jnp.bfloat16)
+            q = linear_ops.linear(hb, lp["q"]).reshape(b, tt, tc.num_heads, hd)
+            k = linear_ops.linear(hb, lp["k"]).reshape(b, tt, tc.num_kv_heads, hd)
+            vv = linear_ops.linear(hb, lp["v"]).reshape(b, tt, tc.num_kv_heads, hd)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            ck_old, cv_old = kv[i]
+            kc = jax.lax.dynamic_update_slice(
+                ck_old, k.astype(ck_old.dtype), (0, pos0, 0, 0))
+            vc2 = jax.lax.dynamic_update_slice(
+                cv_old, vv.astype(cv_old.dtype), (0, pos0, 0, 0))
+            new_kv[i] = (kc, vc2)
+            s = kc.shape[1]
+            # causal mask over the full static cache: key j visible iff
+            # j <= pos0 + query_index
+            qpos = pos0 + jnp.arange(tt)
+            jpos = jnp.arange(s)
+            bias = jnp.where(jpos[None, :] <= qpos[:, None], 0.0, -1e9)
+            bias = bias[None, None, :, :]
+            attn = sdpa_reference(q.astype(jnp.bfloat16),
+                                  kc.astype(jnp.bfloat16),
+                                  vc2.astype(jnp.bfloat16),
+                                  causal=False, bias=bias)
+            attn_out = linear_ops.linear(
+                attn.reshape(b, tt, tc.num_heads * hd).astype(jnp.bfloat16),
+                lp["o"]).astype(jnp.float32)
+            x = x + attn_out
+            h2 = rms_norm(x, lp["mlp_norm"], tc.norm_eps)
+            inner = mlp_ops.gated_act_mul(
+                linear_ops.linear(h2.astype(jnp.bfloat16), lp["gate"]),
+                linear_ops.linear(h2.astype(jnp.bfloat16), lp["up"]), tc.act)
+            x = x + linear_ops.linear(inner, lp["down"]).astype(jnp.float32)
+
+    x = rms_norm(x, t["final_norm"], tc.norm_eps)
+    logits = linear_ops.linear(x.astype(jnp.bfloat16), t["lm_head"]
+                               ).astype(jnp.float32)
+    return logits, new_kv, new_cross
+
+
+# ---------------------------------------------------------------------------
+# model class
+# ---------------------------------------------------------------------------
+
+
+class TPUMllamaForConditionalGeneration:
+    """Llama-3.2-Vision drop-in (cross-attention conditional generation)."""
+
+    def __init__(self, vc: MllamaVisionCfg, tc: MllamaTextCfg, params: dict,
+                 hf_config: dict, qtype: str):
+        self.vision_config = vc
+        self.config = tc
+        self.params = params
+        self.hf_config = hf_config
+        self.qtype = qtype
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.loader import CheckpointReader, read_config
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf = read_config(path)
+        vc = MllamaVisionCfg.from_hf(hf["vision_config"])
+        tc = MllamaTextCfg.from_hf(hf["text_config"])
+        reader = CheckpointReader(path)
+        params = build_mllama_params(vc, tc, reader.get, reader.has, qtype)
+        return cls(vc, tc, params, hf, qtype)
+
+    def _vision_feats(self, pixel_values, aspect_ratio_ids=None,
+                      aspect_ratio_mask=None):
+        """HF-shaped pixel_values [B, n_img, n_tiles, C, H, W] (or
+        [n_tiles, C, H, W]) -> projected cross states [1, Nv, hidden]."""
+        px = np.asarray(pixel_values, np.float32)
+        if px.ndim == 6:
+            if px.shape[0] != 1 or px.shape[1] != 1:
+                raise NotImplementedError(
+                    "mllama supports batch 1 with a single image "
+                    f"(got pixel_values {px.shape})"
+                )
+            px = px.reshape((-1,) + px.shape[-3:])
+        nt = px.shape[0]
+        if nt > self.vision_config.max_num_tiles:
+            raise NotImplementedError(
+                f"{nt} tiles exceed max_num_tiles="
+                f"{self.vision_config.max_num_tiles} (multi-image input)"
+            )
+        ar_id = (int(np.asarray(aspect_ratio_ids).reshape(-1)[0])
+                 if aspect_ratio_ids is not None else 1)
+        mask = (np.asarray(aspect_ratio_mask, np.float32).reshape(-1)[:nt]
+                if aspect_ratio_mask is not None else np.ones(nt, np.float32))
+        feats = mllama_vision_forward(
+            self.vision_config, self.params["vision"], jnp.asarray(px),
+            jnp.asarray(ar_id, jnp.int32), jnp.asarray(mask))
+        proj = linear_ops.linear(
+            feats[None].astype(jnp.bfloat16), self.params["proj"],
+            self.params["proj_b"])
+        return proj.astype(jnp.float32)
+
+    def _prepare_cross_mask(self, cross_attention_mask, n_tiles: int):
+        """HF processor mask [B, T, n_img, n_tiles] -> (bias [1,1,T,Nv],
+        row_mask [1,T,1]); replicates _prepare_cross_attention_mask: each
+        tile entry expands over its num_patches vision tokens, fully-masked
+        rows get an all-zero bias (uniform attention) but a zero row mask
+        on the cross MLP."""
+        m = np.asarray(cross_attention_mask, np.float32)
+        if m.ndim != 4 or m.shape[0] != 1 or m.shape[2] != 1:
+            raise NotImplementedError(
+                "mllama supports batch 1 / single image cross_attention_mask"
+                f" (got {m.shape})"
+            )
+        nv = self.vision_config.num_patches
+        tiles = m[0, :, 0, :n_tiles]                       # [T, n_tiles]
+        expanded = np.repeat(tiles, nv, axis=1)            # [T, Nv]
+        bias = np.where(expanded > 0, 0.0, -1e9).astype(np.float32)
+        row_ok = (expanded > 0).any(axis=1)
+        bias[~row_ok] = 0.0                                # uniform rows
+        row = row_ok.astype(np.float32)[None, :, None]     # [1, T, 1]
+        return jnp.asarray(bias[None, None]), jnp.asarray(row)
+
+    def _fresh_kv(self, cap: int):
+        tc = self.config
+        kv = {}
+        for i in range(tc.num_layers):
+            if i not in tc.cross_attention_layers:
+                kv[i] = (jnp.zeros((1, cap, tc.num_kv_heads, tc.head_dim),
+                                   jnp.bfloat16),
+                         jnp.zeros((1, cap, tc.num_kv_heads, tc.head_dim),
+                                   jnp.bfloat16))
+        return kv
+
+    def _check_ids(self, input_ids):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 2 and ids.shape[0] != 1:
+            raise NotImplementedError("mllama supports batch size 1")
+        return ids.reshape(1, -1)
+
+    def forward_logits(self, input_ids, pixel_values=None,
+                       aspect_ratio_ids=None, aspect_ratio_mask=None,
+                       cross_attention_mask=None):
+        ids = self._check_ids(input_ids)
+        cross = (self._vision_feats(pixel_values, aspect_ratio_ids,
+                                    aspect_ratio_mask)
+                 if pixel_values is not None else None)
+        bias = row = None
+        if cross_attention_mask is not None and cross is not None:
+            nt = np.asarray(pixel_values, np.float32).reshape(
+                (-1,) + np.shape(pixel_values)[-3:]).shape[0]
+            bias, row = self._prepare_cross_mask(cross_attention_mask, nt)
+        kv = self._fresh_kv(ids.shape[1])
+        logits, _, _ = mllama_text_forward(
+            self.config, self.params["text"], jnp.asarray(ids), cross, kv,
+            jnp.asarray(0, jnp.int32), cross_bias=bias, row_mask=row)
+        return logits
+
+    def generate(self, input_ids, pixel_values=None, aspect_ratio_ids=None,
+                 aspect_ratio_mask=None, cross_attention_mask=None,
+                 max_new_tokens: int = 32, **kwargs):
+        ids = self._check_ids(input_ids)
+        n0 = ids.shape[1]
+        cross = (self._vision_feats(pixel_values, aspect_ratio_ids,
+                                    aspect_ratio_mask)
+                 if pixel_values is not None else None)
+        bias = row = None
+        if cross_attention_mask is not None and cross is not None:
+            nt = np.asarray(pixel_values, np.float32).reshape(
+                (-1,) + np.shape(pixel_values)[-3:]).shape[0]
+            bias, row = self._prepare_cross_mask(cross_attention_mask, nt)
+        kv = self._fresh_kv(n0 + max_new_tokens)
+        logits, kv, cross_kv = mllama_text_forward(
+            self.config, self.params["text"], jnp.asarray(ids), cross, kv,
+            jnp.asarray(0, jnp.int32), cross_bias=bias, row_mask=row)
+        # generated tokens reuse the LAST prompt row of the prepared mask
+        # (HF prepare_inputs_for_generation extends it the same way)
+        step_bias = None if bias is None else bias[:, :, -1:, :]
+        step_row = None if row is None else row[:, -1:, :]
+        eos = self.config.eos_token_id
+        eos = set(eos) if isinstance(eos, (list, tuple)) else {eos}
+        out = list(ids[0])
+        tok = int(jnp.argmax(logits[0, -1]))
+        for step in range(max_new_tokens):
+            out.append(tok)
+            if tok in eos:
+                break
+            logits, kv, cross_kv = mllama_text_forward(
+                self.config, self.params["text"],
+                jnp.asarray([[tok]], jnp.int32), None, kv,
+                jnp.asarray(n0 + step, jnp.int32), cross_kv=cross_kv,
+                cross_bias=step_bias, row_mask=step_row)
+            tok = int(jnp.argmax(logits[0, -1]))
+        return np.asarray(out, np.int32)[None]
+
+    # -- low-bit serialization (the save/load_low_bit drop-in contract) ----
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(path, self.params, self.hf_config, self.qtype)
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        vc = MllamaVisionCfg.from_hf(hf["vision_config"])
+        tc = MllamaTextCfg.from_hf(hf["text_config"])
+        return cls(vc, tc, tree, hf, qtype)

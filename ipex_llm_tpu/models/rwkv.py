@@ -55,7 +55,10 @@ class RwkvConfig:
         )
 
 
-def build_rwkv_params(cfg: RwkvConfig, get, has, qtype: str) -> dict:
+def _build_rwkv_frame(num_layers: int, get, qtype: str, attn_weights):
+    """Shared v4/v5 checkpoint scaffold: embeddings, norms, the (identical)
+    feed-forward block, and the stacked layer tree; ``attn_weights(a, lp)``
+    fills the version-specific attention entries for prefix ``a``."""
     from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
 
     def ln(name):
@@ -66,32 +69,42 @@ def build_rwkv_params(cfg: RwkvConfig, get, has, qtype: str) -> dict:
                                               jnp.bfloat16)}
     p["pre_ln"] = ln("rwkv.blocks.0.pre_ln")
     layers = []
-    for i in range(cfg.num_layers):
+    for i in range(num_layers):
         b = f"rwkv.blocks.{i}"
-        a = b + ".attention"
         f = b + ".feed_forward"
         lp = {
             "ln1": ln(b + ".ln1"), "ln2": ln(b + ".ln2"),
-            "time_decay": jnp.asarray(get(a + ".time_decay"), jnp.float32),
-            "time_first": jnp.asarray(get(a + ".time_first"), jnp.float32),
-            "mix_k": jnp.asarray(get(a + ".time_mix_key"), jnp.float32).reshape(-1),
-            "mix_v": jnp.asarray(get(a + ".time_mix_value"), jnp.float32).reshape(-1),
-            "mix_r": jnp.asarray(get(a + ".time_mix_receptance"), jnp.float32).reshape(-1),
-            "wk": quantize_weight(get(a + ".key.weight"), qtype),
-            "wv": quantize_weight(get(a + ".value.weight"), qtype),
-            "wr": quantize_weight(get(a + ".receptance.weight"), qtype),
-            "wo": quantize_weight(get(a + ".output.weight"), qtype),
             "fmix_k": jnp.asarray(get(f + ".time_mix_key"), jnp.float32).reshape(-1),
             "fmix_r": jnp.asarray(get(f + ".time_mix_receptance"), jnp.float32).reshape(-1),
             "fk": quantize_weight(get(f + ".key.weight"), qtype),
             "fr": quantize_weight(get(f + ".receptance.weight"), qtype),
             "fv": quantize_weight(get(f + ".value.weight"), qtype),
         }
+        attn_weights(b + ".attention", lp, ln)
         layers.append(lp)
     p["layers"] = stack_layer_trees(layers)
     p["ln_out"] = ln("rwkv.ln_out")
     p["head"] = quantize_weight(get("head.weight"), qtype)
     return p
+
+
+def build_rwkv_params(cfg: RwkvConfig, get, has, qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight
+
+    def attn(a, lp, ln):
+        lp.update(
+            time_decay=jnp.asarray(get(a + ".time_decay"), jnp.float32),
+            time_first=jnp.asarray(get(a + ".time_first"), jnp.float32),
+            mix_k=jnp.asarray(get(a + ".time_mix_key"), jnp.float32).reshape(-1),
+            mix_v=jnp.asarray(get(a + ".time_mix_value"), jnp.float32).reshape(-1),
+            mix_r=jnp.asarray(get(a + ".time_mix_receptance"), jnp.float32).reshape(-1),
+            wk=quantize_weight(get(a + ".key.weight"), qtype),
+            wv=quantize_weight(get(a + ".value.weight"), qtype),
+            wr=quantize_weight(get(a + ".receptance.weight"), qtype),
+            wo=quantize_weight(get(a + ".output.weight"), qtype),
+        )
+
+    return _build_rwkv_frame(cfg.num_layers, get, qtype, attn)
 
 
 def _wkv_scan(k, v, w, u, state):
@@ -187,6 +200,160 @@ def rwkv_forward(cfg: RwkvConfig, params: dict, tokens: jnp.ndarray,
                     "pp": pp}
 
 
+# ---------------------------------------------------------------------------
+# RWKV-v5: multi-head matrix-valued state (reference rwkv5.py:122-163
+# rwkv_linear_attention_cpu — at = k⊗v outer product, out = r·(u·at + S),
+# S ← at + w·S — plus silu-gated output through a per-head GroupNorm).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rwkv5Config:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    intermediate_size: int
+    num_heads: int           # H = hidden // head_size
+    head_size: int           # config "num_attention_heads" stores head SIZE
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 0
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "Rwkv5Config":
+        h = hf["hidden_size"]
+        # reference rwkv5.py:278: heads = hidden // config.num_attention_heads
+        head_size = hf.get("head_size", hf.get("num_attention_heads", 64))
+        if h % head_size:
+            raise ValueError(f"hidden {h} not divisible by head_size {head_size}")
+        return cls(
+            vocab_size=hf["vocab_size"], hidden_size=h,
+            num_layers=hf["num_hidden_layers"],
+            intermediate_size=hf.get("intermediate_size") or int(3.5 * h),
+            num_heads=h // head_size, head_size=head_size,
+            layer_norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            eos_token_id=hf.get("eos_token_id", 0),
+        )
+
+
+def build_rwkv5_params(cfg: Rwkv5Config, get, has, qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight
+
+    def attn(a, lp, ln):
+        lp.update(
+            ln_x=ln(a + ".ln_x"),
+            # [H, S]: decay w = exp(-exp(td)), bonus u = time_faaaa
+            time_decay=jnp.asarray(get(a + ".time_decay"), jnp.float32)
+            .reshape(cfg.num_heads, cfg.head_size),
+            time_first=jnp.asarray(get(a + ".time_faaaa"), jnp.float32)
+            .reshape(cfg.num_heads, cfg.head_size),
+            mix_k=jnp.asarray(get(a + ".time_mix_key"), jnp.float32).reshape(-1),
+            mix_v=jnp.asarray(get(a + ".time_mix_value"), jnp.float32).reshape(-1),
+            mix_r=jnp.asarray(get(a + ".time_mix_receptance"), jnp.float32).reshape(-1),
+            mix_g=jnp.asarray(get(a + ".time_mix_gate"), jnp.float32).reshape(-1),
+            wk=quantize_weight(get(a + ".key.weight"), qtype),
+            wv=quantize_weight(get(a + ".value.weight"), qtype),
+            wr=quantize_weight(get(a + ".receptance.weight"), qtype),
+            wg=quantize_weight(get(a + ".gate.weight"), qtype),
+            wo=quantize_weight(get(a + ".output.weight"), qtype),
+        )
+
+    return _build_rwkv_frame(cfg.num_layers, get, qtype, attn)
+
+
+def _wkv5_scan(r, k, v, w, u, state):
+    """v5 matrix-state recurrence.  r/k/v [B,T,H,S]; w,u [H,S];
+    state [B,H,S,S] (key-dim x value-dim).  Returns (out [B,T,H,S], state).
+
+    Per step (reference rwkv5.py:148-155): at = k_t ⊗ v_t,
+    out_t = r_t · (u·at + S), S ← at + w·S (w broadcast over value dim)."""
+
+    def step(S, rkv_t):
+        rt, kt, vt = rkv_t                       # [B,H,S]
+        at = kt[..., :, None] * vt[..., None, :]  # [B,H,S,S]
+        out = jnp.einsum("bhk,bhkv->bhv", rt, u[..., None] * at + S)
+        return at + w[..., None] * S, out
+
+    rs = jnp.moveaxis(r, 1, 0)
+    ks = jnp.moveaxis(k, 1, 0)
+    vs = jnp.moveaxis(v, 1, 0)
+    state, outs = jax.lax.scan(step, state, (rs, ks, vs))
+    return jnp.moveaxis(outs, 0, 1), state
+
+
+def _group_norm(x, w, b, groups: int, eps: float):
+    """F.group_norm over the channel dim of x [B,T,C]."""
+    bsz, t, c = x.shape
+    g = x.reshape(bsz, t, groups, c // groups)
+    mu = g.mean(-1, keepdims=True)
+    var = g.var(-1, keepdims=True)
+    g = (g - mu) * jax.lax.rsqrt(var + eps)
+    return g.reshape(bsz, t, c) * w + b
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def rwkv5_forward(cfg: Rwkv5Config, params: dict, tokens: jnp.ndarray,
+                  state: dict | None = None):
+    """tokens [B,T] -> (logits [B,T,V], state); state carries the
+    token-shift streams [L,B,C] and matrix WKV state [L,B,H,S,S]."""
+    b, t = tokens.shape
+    c, h, s = cfg.hidden_size, cfg.num_heads, cfg.head_size
+    x = params["embed"][tokens].astype(jnp.float32)
+    x = layer_norm(x, params["pre_ln"]["w"], params["pre_ln"]["b"],
+                   cfg.layer_norm_eps)
+    if state is None:
+        z = jnp.zeros((cfg.num_layers, b, c), jnp.float32)
+        state = {"att_x": z, "ffn_x": z,
+                 "wkv": jnp.zeros((cfg.num_layers, b, h, s, s), jnp.float32)}
+
+    def block(x, xs):
+        lp, att_x, ffn_x, wkv = xs
+        hid = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"], cfg.layer_norm_eps)
+        hx = _token_shift(hid, att_x)
+        xk = hid * lp["mix_k"] + hx * (1 - lp["mix_k"])
+        xv = hid * lp["mix_v"] + hx * (1 - lp["mix_v"])
+        xr = hid * lp["mix_r"] + hx * (1 - lp["mix_r"])
+        xg = hid * lp["mix_g"] + hx * (1 - lp["mix_g"])
+        r = linear_ops.linear(xr.astype(jnp.bfloat16), lp["wr"]).astype(jnp.float32)
+        k = linear_ops.linear(xk.astype(jnp.bfloat16), lp["wk"]).astype(jnp.float32)
+        v = linear_ops.linear(xv.astype(jnp.bfloat16), lp["wv"]).astype(jnp.float32)
+        g = jax.nn.silu(
+            linear_ops.linear(xg.astype(jnp.bfloat16), lp["wg"]).astype(jnp.float32))
+        w = jnp.exp(-jnp.exp(lp["time_decay"]))
+        out, wkv = _wkv5_scan(
+            r.reshape(b, t, h, s), k.reshape(b, t, h, s),
+            v.reshape(b, t, h, s), w, lp["time_first"], wkv,
+        )
+        out = _group_norm(out.reshape(b, t, c), lp["ln_x"]["w"],
+                          lp["ln_x"]["b"], h, 1e-5) * g
+        x = x + linear_ops.linear(out.astype(jnp.bfloat16), lp["wo"]
+                                  ).astype(jnp.float32)
+        att_x = hid[:, -1]
+
+        h2 = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"], cfg.layer_norm_eps)
+        h2x = _token_shift(h2, ffn_x)
+        fxk = h2 * lp["fmix_k"] + h2x * (1 - lp["fmix_k"])
+        fxr = h2 * lp["fmix_r"] + h2x * (1 - lp["fmix_r"])
+        fr = jax.nn.sigmoid(linear_ops.linear(fxr.astype(jnp.bfloat16), lp["fr"])
+                            .astype(jnp.float32))
+        fk = jnp.square(jax.nn.relu(
+            linear_ops.linear(fxk.astype(jnp.bfloat16), lp["fk"])
+            .astype(jnp.float32)))
+        x = x + fr * linear_ops.linear(fk.astype(jnp.bfloat16), lp["fv"]
+                                       ).astype(jnp.float32)
+        ffn_x = h2[:, -1]
+        return x, (att_x, ffn_x, wkv)
+
+    x, (att_x, ffn_x, wkv) = jax.lax.scan(
+        block, x,
+        (params["layers"], state["att_x"], state["ffn_x"], state["wkv"]),
+    )
+    x = layer_norm(x, params["ln_out"]["w"], params["ln_out"]["b"],
+                   cfg.layer_norm_eps)
+    logits = linear_ops.linear(x.astype(jnp.bfloat16), params["head"]
+                               ).astype(jnp.float32)
+    return logits, {"att_x": att_x, "ffn_x": ffn_x, "wkv": wkv}
+
+
 class TPURwkvForCausalLM:
     """RWKV drop-in: recurrent state instead of a KV cache."""
 
@@ -205,16 +372,25 @@ class TPURwkvForCausalLM:
             "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
         )
         hf = read_config(path)
-        cfg = RwkvConfig.from_hf(hf)
         reader = CheckpointReader(path)
-        params = build_rwkv_params(cfg, reader.get, reader.has, qtype)
+        if hf.get("model_type") == "rwkv5":
+            cfg = Rwkv5Config.from_hf(hf)
+            params = build_rwkv5_params(cfg, reader.get, reader.has, qtype)
+        else:
+            cfg = RwkvConfig.from_hf(hf)
+            params = build_rwkv_params(cfg, reader.get, reader.has, qtype)
         return cls(cfg, params, hf, qtype)
+
+    @property
+    def _forward(self):
+        return (rwkv5_forward if isinstance(self.config, Rwkv5Config)
+                else rwkv_forward)
 
     def __call__(self, input_ids):
         ids = np.asarray(input_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
-        logits, _ = rwkv_forward(self.config, self.params, jnp.asarray(ids))
+        logits, _ = self._forward(self.config, self.params, jnp.asarray(ids))
         return logits
 
     def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
@@ -222,8 +398,8 @@ class TPURwkvForCausalLM:
         if ids.ndim == 2 and ids.shape[0] != 1:
             raise NotImplementedError("rwkv generate supports batch size 1")
         ids = ids.reshape(-1)
-        logits, state = rwkv_forward(self.config, self.params,
-                                     jnp.asarray(ids[None]))
+        logits, state = self._forward(self.config, self.params,
+                                      jnp.asarray(ids[None]))
         out = list(ids)
         eos = self.config.eos_token_id
         for step in range(max_new_tokens):
@@ -231,7 +407,7 @@ class TPURwkvForCausalLM:
             out.append(tok)
             if tok == eos or step == max_new_tokens - 1:
                 break
-            logits, state = rwkv_forward(
+            logits, state = self._forward(
                 self.config, self.params, jnp.asarray([[tok]], jnp.int32),
                 state,
             )

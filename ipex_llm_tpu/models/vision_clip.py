@@ -1,0 +1,178 @@
+"""CLIP vision tower + MLP projector (LLaVA-style vision-language glue).
+
+Reference counterpart: the CLIP/SigLIP towers the reference's multimodal
+patches drive (transformers/models/minicpmv.py, qwen_vl.py all feed a
+ViT's penultimate features through a small projector into the text
+embedding stream).  LLaVA is the canonical open form of that pattern, and
+HF ships mainline modeling code for it, so it doubles as the parity oracle
+for this module.
+
+TPU-first shape choices mirror models/vision.py: the stride==kernel Conv2d
+patch stem runs as one matmul on the MXU, encoder blocks run as a single
+``lax.scan`` body, projections quantize like decoder weights, norms stay
+fp32.  ``feature_layer`` (LLaVA's ``vision_feature_layer``, default -2)
+truncates the scanned block stack instead of collecting every hidden
+state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class ClipVisionConfig:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: int
+    image_size: int
+    norm_eps: float = 1e-5
+    act: str = "quick_gelu"
+    # how many encoder blocks actually run: hidden_states[feature_layer]
+    # (LLaVA vision_feature_layer; -2 = penultimate block output)
+    feature_layer: int = -2
+    select_strategy: str = "default"   # "default" drops CLS, "full" keeps
+    projector_act: str = "gelu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def blocks_to_run(self) -> int:
+        fl = self.feature_layer
+        n = fl if fl >= 0 else self.num_layers + 1 + fl
+        if not 0 <= n <= self.num_layers:
+            raise ValueError(f"vision_feature_layer {fl} out of range")
+        return n
+
+    @classmethod
+    def from_hf(cls, v: dict, feature_layer: int = -2,
+                select_strategy: str = "default",
+                projector_act: str = "gelu") -> "ClipVisionConfig":
+        return cls(
+            hidden_size=v["hidden_size"],
+            num_layers=v["num_hidden_layers"],
+            num_heads=v["num_attention_heads"],
+            intermediate_size=v["intermediate_size"],
+            patch_size=v.get("patch_size", 14),
+            image_size=v.get("image_size", 224),
+            norm_eps=v.get("layer_norm_eps", 1e-5),
+            act=v.get("hidden_act", "quick_gelu"),
+            feature_layer=feature_layer,
+            select_strategy=select_strategy,
+            projector_act=projector_act,
+        )
+
+
+def build_clip_vision_params(vc: ClipVisionConfig, get, has,
+                             qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    vt, mp = "model.vision_tower.vision_model.", "model.multi_modal_projector."
+    if not has(vt + "embeddings.class_embedding"):  # legacy submodel prefixes
+        vt, mp = "vision_tower.vision_model.", "multi_modal_projector."
+    if not has(vt + "embeddings.class_embedding"):
+        raise ValueError("no CLIP vision weights found in checkpoint")
+
+    def gb(lp, key, n):
+        if has(n):
+            lp[key] = jnp.asarray(get(n), jnp.float32)
+
+    p: dict[str, Any] = {}
+    pw = get(vt + "embeddings.patch_embedding.weight")   # [D, C, ps, ps]
+    p["patch_proj"] = quantize_weight(
+        np.ascontiguousarray(pw.reshape(pw.shape[0], -1)), qtype
+    )
+    gb(p, "patch_bias", vt + "embeddings.patch_embedding.bias")
+    p["cls_token"] = jnp.asarray(get(vt + "embeddings.class_embedding"),
+                                 jnp.float32).reshape(1, -1)
+    p["pos"] = jnp.asarray(get(vt + "embeddings.position_embedding.weight"),
+                           jnp.float32)
+    # HF's CLIPVisionTransformer attribute really is spelled "pre_layrnorm"
+    p["pre_ln"] = jnp.asarray(get(vt + "pre_layrnorm.weight"), jnp.float32)
+    gb(p, "pre_ln_b", vt + "pre_layrnorm.bias")
+    layers = []
+    for i in range(vc.blocks_to_run):
+        b = f"{vt}encoder.layers.{i}."
+        lp: dict[str, Any] = {}
+        for key, n in (("ln1", "layer_norm1"), ("ln2", "layer_norm2")):
+            lp[key] = jnp.asarray(get(b + n + ".weight"), jnp.float32)
+            gb(lp, key + "_b", b + n + ".bias")
+        for key, n in (("q", "self_attn.q_proj"), ("k", "self_attn.k_proj"),
+                       ("v", "self_attn.v_proj"), ("o", "self_attn.out_proj"),
+                       ("fc1", "mlp.fc1"), ("fc2", "mlp.fc2")):
+            lp[key] = quantize_weight(get(b + n + ".weight"), qtype)
+            gb(lp, key + "_b", b + n + ".bias")
+        layers.append(lp)
+    p["blocks"] = stack_layer_trees(layers)
+
+    p["proj_fc1"] = quantize_weight(get(mp + "linear_1.weight"), qtype)
+    p["proj_fc1_b"] = jnp.asarray(get(mp + "linear_1.bias"), jnp.float32)
+    p["proj_fc2"] = quantize_weight(get(mp + "linear_2.weight"), qtype)
+    p["proj_fc2_b"] = jnp.asarray(get(mp + "linear_2.bias"), jnp.float32)
+    return p
+
+
+@partial(jax.jit, static_argnames=("vc",))
+def clip_vision_forward(vc: ClipVisionConfig, params: dict,
+                        pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels [B, C, H, W] -> projected image tokens [B, N, text_hidden]."""
+    b, c, hh, ww = pixels.shape
+    ps = vc.patch_size
+    gh, gw = hh // ps, ww // ps
+    patches = pixels.reshape(b, c, gh, ps, gw, ps).transpose(0, 2, 4, 1, 3, 5)
+    patches = patches.reshape(b, gh * gw, c * ps * ps).astype(jnp.bfloat16)
+    x = linear_ops.linear(patches, params["patch_proj"],
+                          params.get("patch_bias")).astype(jnp.float32)
+    cls = jnp.broadcast_to(params["cls_token"][None], (b, 1, vc.hidden_size))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"][None, : x.shape[1]]
+    x = layer_norm(x, params["pre_ln"], params.get("pre_ln_b"), vc.norm_eps)
+    n = x.shape[1]
+
+    def block(x, lp):
+        h = layer_norm(x, lp["ln1"], lp.get("ln1_b"), vc.norm_eps)
+        hb = h.astype(jnp.bfloat16)
+        q = linear_ops.linear(hb, lp["q"], lp.get("q_b"))
+        k = linear_ops.linear(hb, lp["k"], lp.get("k_b"))
+        v = linear_ops.linear(hb, lp["v"], lp.get("v_b"))
+        from ipex_llm_tpu.ops.attention import sdpa_reference
+
+        attn = sdpa_reference(
+            q.reshape(b, n, vc.num_heads, vc.head_dim),
+            k.reshape(b, n, vc.num_heads, vc.head_dim),
+            v.reshape(b, n, vc.num_heads, vc.head_dim),
+            causal=False,
+        ).reshape(b, n, vc.hidden_size)
+        x = x + linear_ops.linear(attn, lp["o"], lp.get("o_b")
+                                  ).astype(jnp.float32)
+        h2 = layer_norm(x, lp["ln2"], lp.get("ln2_b"), vc.norm_eps)
+        inner = mlp_ops.act(
+            linear_ops.linear(h2.astype(jnp.bfloat16), lp["fc1"],
+                              lp.get("fc1_b")), vc.act,
+        )
+        x = x + linear_ops.linear(inner, lp["fc2"], lp.get("fc2_b")
+                                  ).astype(jnp.float32)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+
+    feats = x[:, 1:] if vc.select_strategy == "default" else x
+    h = mlp_ops.act(
+        linear_ops.linear(feats.astype(jnp.bfloat16), params["proj_fc1"],
+                          params["proj_fc1_b"]), vc.projector_act,
+    )
+    return linear_ops.linear(h, params["proj_fc2"], params["proj_fc2_b"])

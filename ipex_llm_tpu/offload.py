@@ -134,6 +134,9 @@ def _layer_attn_router(cfg: ModelConfig, layer, params, x, kl, vl,
         cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
         sliding, cache, 0,
     )
+    if cfg.residual_multiplier != 1.0:  # minicpm-style depth scaling
+        attn_out = attn_out * jnp.asarray(cfg.residual_multiplier,
+                                          attn_out.dtype)
     x = x + attn_out
     h = dec._norm(x, lp["mlp_norm"], cfg)
     router_logits = jnp.matmul(h.astype(jnp.float32), lp["router"])
@@ -181,6 +184,8 @@ def _apply_experts(cfg: ModelConfig, n_exp: int, layer, params, x, h,
                                           lp["shared_router"]))
             ys = ys * g.astype(ys.dtype)
         y = y + ys
+    if cfg.residual_multiplier != 1.0:  # minicpm-style depth scaling
+        y = y * jnp.asarray(cfg.residual_multiplier, y.dtype)
     return x + y
 
 
@@ -201,6 +206,8 @@ def _final_logits(cfg: ModelConfig, params, x):
     else:
         logits = linear_ops.linear(x, lm_head, params.get("lm_head_bias"))
     logits = logits.astype(jnp.float32)
+    if cfg.logit_scale != 1.0:  # cohere/minicpm logits multiplier
+        logits = logits * cfg.logit_scale
     if cfg.logit_softcap is not None:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
     return logits[:, 0]

@@ -100,7 +100,7 @@ class TPUModelForCausalLM:
         kwargs.pop("trust_remote_code", None)
 
         hf_config = read_config(path)
-        if hf_config.get("model_type") == "rwkv":
+        if hf_config.get("model_type") in ("rwkv", "rwkv5"):
             # recurrent family: state instead of a KV cache (models/rwkv.py)
             from ipex_llm_tpu.models.rwkv import TPURwkvForCausalLM
 

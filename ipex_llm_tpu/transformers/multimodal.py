@@ -408,9 +408,90 @@ class TPUInternVLForConditionalGeneration:
         return cls(cfg, vcfg, tree["text"], tree["vision"], hf, qtype)
 
 
+class TPULlavaForConditionalGeneration(TPUInternVLForConditionalGeneration):
+    """LLaVA: CLIP tower (penultimate features) + MLP projector + llama-family
+    text, all through the shared decoder's embed-replacement path.
+
+    Reference counterpart: the CLIP-tower+projector pattern of the
+    reference's multimodal patches (minicpmv.py / qwen_vl.py); HF's mainline
+    ``LlavaForConditionalGeneration`` is the weight source and oracle.
+    Inherits forward/generate/save from the InternVL glue — only the vision
+    tower and config wiring differ."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_clip import (
+            ClipVisionConfig,
+            build_clip_vision_params,
+        )
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        text = dict(hf_config["text_config"])
+        fam = get_family(text.get("model_type", "llama"))
+        cfg = fam.to_config(text)
+        vcfg = ClipVisionConfig.from_hf(
+            hf_config["vision_config"],
+            feature_layer=hf_config.get("vision_feature_layer", -2),
+            select_strategy=hf_config.get("vision_feature_select_strategy",
+                                          "default"),
+            projector_act=hf_config.get("projector_hidden_act", "gelu"),
+        )
+        reader = _AliasReader(CheckpointReader(path))
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_clip_vision_params(
+            vcfg, reader.reader.get, reader.reader.has, qtype
+        )
+        m = cls(cfg, vcfg, params, vparams, hf_config, qtype)
+        m.image_token_id = hf_config.get("image_token_index", 32000)
+        return m
+
+    def _embed_multimodal(self, ids: np.ndarray, pixel_values):
+        from ipex_llm_tpu.models.vision_clip import clip_vision_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
+        if pixel_values is not None:
+            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            img = clip_vision_forward(
+                self.vision_config, self.vision_params, px
+            ).reshape(-1, x.shape[-1]).astype(x.dtype)
+            (idx,) = np.nonzero(np.asarray(ids) == self.image_token_id)
+            assert len(idx) == img.shape[0], (
+                f"{len(idx)} image tokens vs {img.shape[0]} image embeds"
+            )
+            x = x.at[0, jnp.asarray(idx)].set(img)
+        return x
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_clip import ClipVisionConfig
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        text = dict(hf["text_config"])
+        cfg = get_family(text.get("model_type", "llama")).to_config(text)
+        vcfg = ClipVisionConfig.from_hf(
+            hf["vision_config"],
+            feature_layer=hf.get("vision_feature_layer", -2),
+            select_strategy=hf.get("vision_feature_select_strategy",
+                                   "default"),
+            projector_act=hf.get("projector_hidden_act", "gelu"),
+        )
+        m = cls(cfg, vcfg, tree["text"], tree["vision"], hf, qtype)
+        m.image_token_id = hf.get("image_token_index", 32000)
+        return m
+
+
 class AutoModelForVision2Seq:
     """Vision-language loader dispatching by model_type (qwen2_vl,
-    internvl)."""
+    internvl, llava)."""
 
     @classmethod
     def from_pretrained(cls, path: str, **kwargs):
@@ -421,8 +502,21 @@ class AutoModelForVision2Seq:
             return TPUInternVLForConditionalGeneration.from_pretrained(
                 str(path), **kwargs
             )
+        if mt == "llava":
+            return TPULlavaForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
+        if mt == "mllama":
+            from ipex_llm_tpu.models.mllama import (
+                TPUMllamaForConditionalGeneration,
+            )
+
+            return TPUMllamaForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
         raise ValueError(
-            f"AutoModelForVision2Seq supports qwen2_vl/internvl; got {mt!r}"
+            f"AutoModelForVision2Seq supports qwen2_vl/internvl/llava/mllama; "
+            f"got {mt!r}"
         )
 
     @classmethod
@@ -438,6 +532,14 @@ class AutoModelForVision2Seq:
             return TPUModelForVision2Seq.load_low_bit(str(path))
         if mt == "internvl":
             return TPUInternVLForConditionalGeneration.load_low_bit(str(path))
+        if mt == "llava":
+            return TPULlavaForConditionalGeneration.load_low_bit(str(path))
+        if mt == "mllama":
+            from ipex_llm_tpu.models.mllama import (
+                TPUMllamaForConditionalGeneration,
+            )
+
+            return TPUMllamaForConditionalGeneration.load_low_bit(str(path))
         raise ValueError(
-            f"load_low_bit supports qwen2_vl/internvl; got {mt!r}"
+            f"load_low_bit supports qwen2_vl/internvl/llava/mllama; got {mt!r}"
         )

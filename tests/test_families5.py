@@ -1,0 +1,229 @@
+"""Model-family wave 5: qwen(v1) / gpt_bigcode / internlm(v1) / aquila /
+minicpm / minicpm3.
+
+gpt_bigcode has mainline HF modeling code, so it gets direct logits parity.
+qwen / internlm / aquila / minicpm ship no mainline HF code (remote-code
+repos); like baichuan/internlm2 in test_families.py their layouts are
+validated by round-tripping a llama checkpoint through their weight naming
+(bit-identical math, different packing/config keys), and minicpm's muP
+scalings are checked analytically.  minicpm3 reuses the DeepseekV2 HF
+oracle for its MLA math (same low-rank weight names).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOKENS = np.random.default_rng(5).integers(0, 150, (2, 10)).astype(np.int32)
+
+
+def _save_synthetic(tmp_path, name, config: dict, tensors: dict):
+    import safetensors.numpy
+
+    path = tmp_path / name
+    path.mkdir()
+    safetensors.numpy.save_file(
+        {k: np.ascontiguousarray(v) for k, v in tensors.items()},
+        str(path / "model.safetensors"),
+    )
+    (path / "config.json").write_text(json.dumps(config))
+    return str(path)
+
+
+def _load_logits(path):
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    return np.asarray(model(TOKENS))
+
+
+def _mha_llama(tmp_path, seed=7):
+    """4-head MHA tiny llama (qwen v1 has no GQA)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        tie_word_embeddings=False, max_position_embeddings=256,
+    )
+    torch.manual_seed(seed)
+    model = LlamaForCausalLM(cfg).eval()
+    sd = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    with torch.no_grad():
+        want = model(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    return cfg, sd, want
+
+
+def test_gptbigcode_mqa_logits(tmp_path):
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+
+    cfg = GPTBigCodeConfig(
+        vocab_size=150, n_embd=64, n_inner=128, n_layer=2, n_head=4,
+        n_positions=256, multi_query=True,
+        activation_function="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(0)
+    hf = GPTBigCodeForCausalLM(cfg).eval()
+    path = str(tmp_path / "bigcode")
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _load_logits(path)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+
+def test_gptbigcode_mha_logits(tmp_path):
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+
+    cfg = GPTBigCodeConfig(
+        vocab_size=150, n_embd=64, n_inner=128, n_layer=2, n_head=4,
+        n_positions=256, multi_query=False,
+        activation_function="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(1)
+    hf = GPTBigCodeForCausalLM(cfg).eval()
+    path = str(tmp_path / "bigcode_mha")
+    hf.save_pretrained(path, safe_serialization=True)
+    got = _load_logits(path)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_qwen_v1_layout(tmp_path):
+    """Qwen-7B-style checkpoint: transformer.h naming, fused c_attn,
+    w2=gate / w1=up (reference qwen.py:261), doubled intermediate_size."""
+    cfg, sd, want = _mha_llama(tmp_path)
+    tensors = {
+        "transformer.wte.weight": sd["model.embed_tokens.weight"],
+        "transformer.ln_f.weight": sd["model.norm.weight"],
+        "lm_head.weight": sd["lm_head.weight"],
+    }
+    for i in range(cfg.num_hidden_layers):
+        src = f"model.layers.{i}."
+        dst = f"transformer.h.{i}."
+        tensors[dst + "ln_1.weight"] = sd[src + "input_layernorm.weight"]
+        tensors[dst + "ln_2.weight"] = sd[src + "post_attention_layernorm.weight"]
+        tensors[dst + "attn.c_attn.weight"] = np.concatenate(
+            [sd[src + "self_attn.q_proj.weight"],
+             sd[src + "self_attn.k_proj.weight"],
+             sd[src + "self_attn.v_proj.weight"]], axis=0)
+        tensors[dst + "attn.c_proj.weight"] = sd[src + "self_attn.o_proj.weight"]
+        tensors[dst + "mlp.w2.weight"] = sd[src + "mlp.gate_proj.weight"]
+        tensors[dst + "mlp.w1.weight"] = sd[src + "mlp.up_proj.weight"]
+        tensors[dst + "mlp.c_proj.weight"] = sd[src + "mlp.down_proj.weight"]
+    config = {
+        "model_type": "qwen", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 256, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "kv_channels": 16,
+        "layer_norm_epsilon": 1e-6, "seq_length": 256,
+        "rotary_emb_base": 10000.0, "no_bias": True,
+    }
+    path = _save_synthetic(tmp_path, "qwen", config, tensors)
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_internlm_v1_layout(tmp_path):
+    """internlm v1 keeps llama weight names; only model_type + the single
+    ``bias`` flag differ."""
+    cfg, sd, want = _mha_llama(tmp_path, seed=8)
+    config = {
+        "model_type": "internlm", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256, "bias": False,
+    }
+    path = _save_synthetic(tmp_path, "internlm", config, sd)
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_aquila_layout(tmp_path):
+    cfg, sd, want = _mha_llama(tmp_path, seed=9)
+    config = {
+        "model_type": "aquila", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+    }
+    path = _save_synthetic(tmp_path, "aquila", config, sd)
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def _minicpm_config(L=2, **over):
+    d = {
+        "model_type": "minicpm", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": L,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+        # neutral muP knobs: rm = scale_depth/sqrt(L) = 1, logit_scale = 1
+        "scale_emb": 1.0, "scale_depth": float(np.sqrt(L)),
+        "dim_model_base": 64,
+    }
+    d.update(over)
+    return d
+
+
+def test_minicpm_neutral_matches_llama(tmp_path):
+    cfg, sd, want = _mha_llama(tmp_path, seed=10)
+    path = _save_synthetic(tmp_path, "minicpm", _minicpm_config(), sd)
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_minicpm_mup_scalings(tmp_path):
+    """logit_scale = dim_model_base/hidden is exactly linear in the logits;
+    scale_emb and scale_depth must change them (reference minicpm.py:58)."""
+    cfg, sd, _ = _mha_llama(tmp_path, seed=11)
+    base = _load_logits(
+        _save_synthetic(tmp_path, "m_base", _minicpm_config(), sd))
+    halved = _load_logits(
+        _save_synthetic(tmp_path, "m_half",
+                        _minicpm_config(dim_model_base=32), sd))
+    assert np.allclose(halved, 0.5 * base, rtol=1e-2, atol=1e-2)
+    scaled = _load_logits(
+        _save_synthetic(tmp_path, "m_depth",
+                        _minicpm_config(scale_depth=0.5 * np.sqrt(2),
+                                        scale_emb=2.0), sd))
+    assert np.isfinite(scaled).all()
+    assert np.abs(scaled - base).max() / np.abs(base).max() > 0.01
+
+
+def test_minicpm3_mla_matches_deepseek(tmp_path):
+    """minicpm3 = deepseek MLA weight names + muP scalings; with neutral
+    scalings the same tensors must produce the deepseek_v2 logits."""
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        q_lora_rank=48, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, n_routed_experts=None,
+        first_k_dense_replace=99, max_position_embeddings=256,
+        attention_bias=False, tie_word_embeddings=False,
+    )
+    torch.manual_seed(12)
+    hf = DeepseekV2ForCausalLM(cfg).eval()
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+    with torch.no_grad():
+        want = hf(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    config = {
+        "model_type": "minicpm3", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+        "q_lora_rank": 48, "kv_lora_rank": 32, "qk_nope_head_dim": 16,
+        "qk_rope_head_dim": 8, "v_head_dim": 16,
+        "scale_emb": 1.0, "scale_depth": float(np.sqrt(2)),
+        "dim_model_base": 64,
+    }
+    path = _save_synthetic(tmp_path, "minicpm3", config, sd)
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
