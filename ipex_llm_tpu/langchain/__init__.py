@@ -4,6 +4,15 @@ Import-guarded: langchain is an optional dependency; the classes raise a
 clear error at construction when it is absent.
 """
 
+from ipex_llm_tpu.langchain.embeddings import (
+    TransformersBgeEmbeddings,
+    TransformersEmbeddings,
+)
 from ipex_llm_tpu.langchain.llms import TransformersLLM, TransformersPipelineLLM
 
-__all__ = ["TransformersLLM", "TransformersPipelineLLM"]
+__all__ = [
+    "TransformersLLM",
+    "TransformersPipelineLLM",
+    "TransformersEmbeddings",
+    "TransformersBgeEmbeddings",
+]

@@ -1,0 +1,77 @@
+"""LangChain embeddings over the TPU BERT encoder.
+
+Reference counterpart: ``TransformersEmbeddings`` / ``TransformersBgeEmbeddings``
+(reference langchain/embeddings/transformersembeddings.py:59,188 —
+from_model_id classmethod, embed_documents/embed_query).  Backed by
+models/bert.py's jitted encoder + mean/cls pooling; works without langchain
+installed (plain duck-typed class, same pattern as langchain/llms.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+class TransformersEmbeddings:
+    """Mean-pooled sentence embeddings (bge/gte/e5-class encoders)."""
+
+    pooling = "mean"
+
+    def __init__(self, model, tokenizer, model_kwargs: dict | None = None,
+                 encode_kwargs: dict | None = None):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.model_kwargs = model_kwargs or {}
+        self.encode_kwargs = encode_kwargs or {}
+
+    @classmethod
+    def from_model_id(cls, model_id: str, model_kwargs: dict | None = None,
+                      encode_kwargs: dict | None = None, **kwargs: Any):
+        from transformers import AutoTokenizer
+
+        from ipex_llm_tpu.transformers import AutoModel
+
+        mk = dict(model_kwargs or {})
+        low_bit = mk.pop("load_in_low_bit", kwargs.pop("load_in_low_bit",
+                                                       "sym_int4"))
+        model = AutoModel.from_pretrained(model_id, load_in_low_bit=low_bit)
+        tok = AutoTokenizer.from_pretrained(model_id, trust_remote_code=True)
+        return cls(model, tok, mk, encode_kwargs)
+
+    def embed(self, text: str) -> List[float]:
+        enc = self.tokenizer(text, **self.encode_kwargs)
+        import numpy as np
+
+        ids = np.asarray(enc["input_ids"], np.int32).reshape(1, -1)
+        mask = np.asarray(enc.get("attention_mask",
+                                  np.ones_like(ids)), np.int32).reshape(1, -1)
+        # pad to a power-of-two length bucket so varying document lengths
+        # reuse a handful of compiled encoder programs instead of one XLA
+        # compile per unique length (mean pooling is mask-aware; CLS is
+        # position 0 — padding is invisible to both)
+        t = ids.shape[1]
+        max_t = getattr(self.model.config, "max_position_embeddings", 512)
+        bucket = 16
+        while bucket < t:
+            bucket *= 2
+        bucket = min(bucket, max_t)
+        if t > bucket:       # over-long input: truncate to the model window
+            ids, mask = ids[:, :bucket], mask[:, :bucket]
+        elif t < bucket:
+            pad = bucket - t
+            ids = np.pad(ids, ((0, 0), (0, pad)))
+            mask = np.pad(mask, ((0, 0), (0, pad)))
+        return self.model.embed(ids, attention_mask=mask,
+                                pooling=self.pooling)[0].tolist()
+
+    def embed_documents(self, texts: List[str]) -> List[List[float]]:
+        return [self.embed(t) for t in texts]
+
+    def embed_query(self, text: str) -> List[float]:
+        return self.embed(text)
+
+
+class TransformersBgeEmbeddings(TransformersEmbeddings):
+    """BGE-style: CLS pooling (reference transformersembeddings.py:188)."""
+
+    pooling = "cls"

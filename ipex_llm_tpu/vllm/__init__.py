@@ -1,0 +1,244 @@
+"""vLLM-compatible API facade over the paged continuous-batching engine.
+
+Reference counterpart: the ipex-llm vLLM integration
+(reference python/llm/src/ipex_llm/vllm/xpu/ — engine wrappers whose added
+surface is the ``load_in_low_bit`` kwarg on vLLM's ``LLM`` /
+``AsyncLLMEngine``).  The reference forks vLLM and swaps its linear layers;
+here the same USER API is served by this framework's own TPU engine
+(serving/engine.py: paged block-table KV, prefix caching, chunked prefill),
+so vLLM scripts port by changing only the import:
+
+    from ipex_llm_tpu.vllm import LLM, SamplingParams
+    llm = LLM(model=path, load_in_low_bit="sym_int4")
+    outs = llm.generate(["hello"], SamplingParams(max_tokens=32))
+
+No vLLM installation is required or used.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional, Sequence
+
+__all__ = [
+    "SamplingParams",
+    "CompletionOutput",
+    "RequestOutput",
+    "LLM",
+    "EngineArgs",
+    "AsyncEngineArgs",
+    "AsyncLLMEngine",
+]
+
+
+@dataclass
+class SamplingParams:
+    """vLLM's sampling knobs (the subset the TPU engine implements).
+
+    ``n``/``best_of`` > 1 and beam search are not supported; penalties are
+    accepted but ignored (documented deviation, like the reference's
+    unsupported-kwarg passthrough)."""
+
+    n: int = 1
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1
+    max_tokens: int = 16
+    stop: Optional[Sequence[str]] = None
+    stop_token_ids: Optional[Sequence[int]] = None
+    ignore_eos: bool = False
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+
+    def __post_init__(self):
+        if self.n != 1:
+            raise NotImplementedError("SamplingParams.n > 1 is not supported")
+
+
+@dataclass
+class CompletionOutput:
+    index: int
+    text: str
+    token_ids: list[int]
+    finish_reason: Optional[str] = None
+    cumulative_logprob: float = 0.0
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt: Optional[str]
+    prompt_token_ids: list[int]
+    outputs: list[CompletionOutput]
+    finished: bool = True
+
+    @property
+    def num_generated_tokens(self) -> int:
+        return sum(len(o.token_ids) for o in self.outputs)
+
+
+def _to_engine_request(prompt_ids, sp: SamplingParams, eos, request_id):
+    from ipex_llm_tpu.serving.engine import Request
+
+    # ignore_eos suppresses only the model EOS (vLLM semantics); explicit
+    # stop_token_ids stay active either way
+    stop_ids = tuple(sp.stop_token_ids or ())
+    eos_ids = (() if sp.ignore_eos else tuple(eos)) + stop_ids
+    return Request(
+        prompt_ids=list(map(int, prompt_ids)),
+        max_new_tokens=sp.max_tokens,
+        temperature=float(sp.temperature),
+        top_p=float(sp.top_p),
+        eos_token_id=eos_ids,
+        stop_strings=list(sp.stop or []),
+        request_id=request_id or f"cmpl-{uuid.uuid4().hex[:16]}",
+    )
+
+
+class LLM:
+    """Offline batch inference with the vLLM ``LLM`` surface."""
+
+    def __init__(self, model: str, tokenizer: str | None = None,
+                 load_in_low_bit: str = "sym_int4",
+                 quantization: str | None = None,
+                 trust_remote_code: bool = True, dtype: str = "auto",
+                 max_model_len: int = 4096, max_num_seqs: int = 8,
+                 **kwargs: Any):
+        from transformers import AutoTokenizer
+
+        from ipex_llm_tpu.serving.engine import EngineConfig, ServingEngine
+        from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+        if quantization is not None:
+            # vLLM spelling; the reference maps it onto low-bit formats too
+            load_in_low_bit = {"awq": "asym_int4", "gptq": "sym_int4",
+                               "fp8": "fp8"}.get(quantization.lower(),
+                                                 quantization)
+        self._model = AutoModelForCausalLM.from_pretrained(
+            model, load_in_low_bit=load_in_low_bit
+        )
+        self._tok = AutoTokenizer.from_pretrained(
+            tokenizer or model, trust_remote_code=trust_remote_code
+        )
+        eos = self._model.generation_config.eos_token_id
+        self._eos = tuple(eos) if isinstance(eos, (list, tuple)) else (
+            (eos,) if eos is not None else ())
+        self._engine = ServingEngine(
+            self._model.config, self._model.params,
+            EngineConfig(max_rows=max_num_seqs, max_seq_len=max_model_len),
+            default_eos=self._eos,
+        ).start()
+
+    def get_tokenizer(self):
+        return self._tok
+
+    def generate(self, prompts=None, sampling_params: SamplingParams | None
+                 = None, prompt_token_ids=None,
+                 use_tqdm: bool = False) -> list[RequestOutput]:
+        from ipex_llm_tpu.serving.engine import stream_tokens
+
+        sp = sampling_params or SamplingParams()
+        if prompts is not None and isinstance(prompts, str):
+            prompts = [prompts]
+        if prompt_token_ids is None:
+            prompt_token_ids = [self._tok(p)["input_ids"] for p in prompts]
+        reqs = []
+        for i, ids in enumerate(prompt_token_ids):
+            req = _to_engine_request(ids, sp, self._eos, None)
+            reqs.append(self._engine.submit(req))
+        outs = []
+        for i, req in enumerate(reqs):
+            toks = list(stream_tokens(req))
+            text = self._tok.decode(toks, skip_special_tokens=True)
+            outs.append(RequestOutput(
+                request_id=req.request_id,
+                prompt=prompts[i] if prompts is not None else None,
+                prompt_token_ids=list(req.prompt_ids),
+                outputs=[CompletionOutput(0, text, toks,
+                                          req.finish_reason)],
+                finished=True,
+            ))
+        return outs
+
+    def shutdown(self):
+        self._engine.stop()
+
+
+@dataclass
+class EngineArgs:
+    """vLLM's EngineArgs names, mapped onto the TPU engine."""
+
+    model: str
+    tokenizer: str | None = None
+    load_in_low_bit: str = "sym_int4"
+    quantization: str | None = None
+    max_model_len: int = 4096
+    max_num_seqs: int = 8
+    trust_remote_code: bool = True
+    extra: dict = field(default_factory=dict)
+
+
+AsyncEngineArgs = EngineArgs
+
+
+class AsyncLLMEngine:
+    """vLLM's async streaming surface over the same engine."""
+
+    def __init__(self, llm: LLM):
+        self._llm = llm
+        self._requests: dict[str, Any] = {}
+
+    @classmethod
+    def from_engine_args(cls, args: EngineArgs) -> "AsyncLLMEngine":
+        return cls(LLM(
+            model=args.model, tokenizer=args.tokenizer,
+            load_in_low_bit=args.load_in_low_bit,
+            quantization=args.quantization,
+            max_model_len=args.max_model_len,
+            max_num_seqs=args.max_num_seqs,
+            trust_remote_code=args.trust_remote_code,
+        ))
+
+    async def generate(self, prompt: str | None, sampling_params:
+                       SamplingParams, request_id: str,
+                       prompt_token_ids=None) -> AsyncIterator[RequestOutput]:
+        """Yields cumulative RequestOutput snapshots (vLLM semantics)."""
+        llm = self._llm
+        if prompt_token_ids is None:
+            prompt_token_ids = llm._tok(prompt)["input_ids"]
+        req = _to_engine_request(prompt_token_ids, sampling_params,
+                                 llm._eos, request_id)
+        self._requests[req.request_id] = req
+        llm._engine.submit(req)
+        loop = asyncio.get_running_loop()
+        toks: list[int] = []
+        while True:
+            tok = await loop.run_in_executor(None, req.stream_queue.get)
+            if tok is None:
+                break
+            toks.append(tok)
+            yield RequestOutput(
+                request_id=req.request_id, prompt=prompt,
+                prompt_token_ids=list(req.prompt_ids),
+                outputs=[CompletionOutput(
+                    0, llm._tok.decode(toks, skip_special_tokens=True),
+                    list(toks))],
+                finished=False,
+            )
+        self._requests.pop(req.request_id, None)
+        yield RequestOutput(
+            request_id=req.request_id, prompt=prompt,
+            prompt_token_ids=list(req.prompt_ids),
+            outputs=[CompletionOutput(
+                0, llm._tok.decode(toks, skip_special_tokens=True),
+                list(toks), req.finish_reason)],
+            finished=True,
+        )
+
+    async def abort(self, request_id: str) -> None:
+        """Cooperative cancel: the engine frees the row on its next step."""
+        req = self._requests.pop(request_id, None)
+        if req is not None:
+            self._llm._engine.abort(req)

@@ -1,0 +1,312 @@
+"""vLLM-compat facade + FastChat worker over the paged engine.
+
+Reference counterparts: ipex_llm/vllm/xpu (LLM/AsyncLLMEngine wrappers with
+``load_in_low_bit``) and serving/fastchat/ipex_llm_worker.py (controller
+protocol, NUL-delimited cumulative-text stream).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    path = str(tmp_path_factory.mktemp("vllm") / "m")
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(cfg).eval().save_pretrained(path,
+                                                 safe_serialization=True)
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    vocab = {chr(i + 32): i for i in range(0, 224)}
+    vocab["<unk>"] = 224
+    vocab["</s>"] = 225
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tok, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(path)
+    return path
+
+
+def test_vllm_llm_generate(tiny_ckpt):
+    from ipex_llm_tpu.vllm import LLM, SamplingParams
+
+    llm = LLM(model=tiny_ckpt, load_in_low_bit="sym_int4", max_num_seqs=4,
+              max_model_len=256)
+    try:
+        outs = llm.generate(["hello", "world!"],
+                            SamplingParams(temperature=0.0, max_tokens=6))
+        assert len(outs) == 2
+        for o, prompt in zip(outs, ["hello", "world!"]):
+            assert o.finished and o.prompt == prompt
+            assert 1 <= len(o.outputs[0].token_ids) <= 6
+            assert o.outputs[0].finish_reason in ("stop", "length")
+        # greedy must be deterministic across calls
+        outs2 = llm.generate(["hello"],
+                             SamplingParams(temperature=0.0, max_tokens=6))
+        assert outs2[0].outputs[0].token_ids == outs[0].outputs[0].token_ids
+    finally:
+        llm.shutdown()
+
+
+def test_vllm_async_engine_streams(tiny_ckpt):
+    import asyncio
+
+    from ipex_llm_tpu.vllm import (
+        AsyncEngineArgs,
+        AsyncLLMEngine,
+        SamplingParams,
+    )
+
+    eng = AsyncLLMEngine.from_engine_args(AsyncEngineArgs(
+        model=tiny_ckpt, max_num_seqs=2, max_model_len=256))
+
+    async def run():
+        snaps = []
+        async for out in eng.generate(
+                "hi", SamplingParams(temperature=0.0, max_tokens=5), "r1"):
+            snaps.append(out)
+        return snaps
+
+    try:
+        snaps = asyncio.run(run())
+        assert snaps[-1].finished
+        assert 1 <= len(snaps[-1].outputs[0].token_ids) <= 5
+        # cumulative: token lists grow monotonically
+        lens = [len(s.outputs[0].token_ids) for s in snaps]
+        assert lens == sorted(lens)
+    finally:
+        eng._llm.shutdown()
+
+
+def test_vllm_unsupported_n_raises():
+    from ipex_llm_tpu.vllm import SamplingParams
+
+    with pytest.raises(NotImplementedError):
+        SamplingParams(n=2)
+
+
+def test_fastchat_worker_stream(tiny_ckpt):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ipex_llm_tpu.serving.fastchat_worker import build_worker
+
+    w = build_worker(tiny_ckpt, low_bit="sym_int4", controller_addr=None,
+                     limit_worker_concurrency=2)
+
+    async def run():
+        async with TestClient(TestServer(w.app)) as client:
+            r = await client.post("/worker_get_status", json={})
+            status = await r.json()
+            assert status["model_names"] and status["queue_length"] == 0
+
+            r = await client.post("/count_token", json={"prompt": "hello"})
+            assert (await r.json())["count"] == 5
+
+            r = await client.post("/worker_generate_stream",
+                                  json={"prompt": "hello", "temperature": 0,
+                                        "max_new_tokens": 5, "echo": True})
+            raw = await r.read()
+            chunks = [json.loads(c) for c in raw.split(b"\0") if c]
+            assert chunks, "no stream chunks"
+            assert chunks[-1]["finish_reason"] in ("stop", "length", "eos")
+            assert chunks[-1]["error_code"] == 0
+            assert chunks[-1]["text"].startswith("hello")
+            assert chunks[-1]["usage"]["prompt_tokens"] == 5
+            # cumulative text grows
+            texts = [c["text"] for c in chunks]
+            assert all(texts[i + 1].startswith(texts[i][:len("hello")])
+                       for i in range(len(texts) - 1))
+
+            r = await client.post("/worker_generate",
+                                  json={"prompt": "abc", "temperature": 0,
+                                        "max_new_tokens": 3, "echo": False})
+            final = await r.json()
+            assert final["finish_reason"] is not None
+            return True
+
+    try:
+        assert asyncio.run(run())
+    finally:
+        w.engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# bert encoder (embedding family) — reference transformers/models/bert.py
+# ---------------------------------------------------------------------------
+
+
+def test_bert_logits_parity(tmp_path):
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(
+        vocab_size=120, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+    )
+    torch.manual_seed(0)
+    hf = BertModel(cfg).eval()
+    path = str(tmp_path / "bert")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    ids = np.random.default_rng(1).integers(0, 120, (2, 9)).astype(np.int64)
+    mask = np.ones((2, 9), np.int64)
+    mask[1, 6:] = 0
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 attention_mask=torch.from_numpy(mask))
+    want_h = out.last_hidden_state.float().numpy()
+    want_p = out.pooler_output.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModel
+
+    m = AutoModel.from_pretrained(path, load_in_low_bit="bf16")
+    got_h, got_p = m(ids, attention_mask=mask)
+    got_h, got_p = np.asarray(got_h), np.asarray(got_p)
+    # masked positions are undefined; compare valid slots only
+    valid = mask.astype(bool)
+    err = np.abs(got_h[valid] - want_h[valid]).max() / np.abs(want_h).max()
+    assert err < 0.06, err
+    errp = np.abs(got_p - want_p).max() / np.abs(want_p).max()
+    assert errp < 0.06, errp
+
+    # sentence-embedding helper: unit-norm, deterministic, mask-aware
+    e = m.embed(ids, attention_mask=mask)
+    assert e.shape == (2, 64)
+    assert np.allclose(np.linalg.norm(e, axis=-1), 1.0, atol=1e-5)
+    e2 = m.embed(ids, attention_mask=mask)
+    assert np.allclose(e, e2)
+
+
+def test_langchain_embeddings(tmp_path):
+    """TransformersEmbeddings over the bert encoder (reference
+    langchain/embeddings/transformersembeddings.py)."""
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64)
+    torch.manual_seed(2)
+    path = str(tmp_path / "bert_lc")
+    BertModel(cfg).eval().save_pretrained(path, safe_serialization=True)
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    vocab = {chr(i + 32): i for i in range(0, 90)}
+    vocab["<unk>"] = 90
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tok,
+                            unk_token="<unk>").save_pretrained(path)
+
+    from ipex_llm_tpu.langchain import (
+        TransformersBgeEmbeddings,
+        TransformersEmbeddings,
+    )
+
+    emb = TransformersEmbeddings.from_model_id(
+        path, model_kwargs={"load_in_low_bit": "bf16"})
+    docs = emb.embed_documents(["hello world", "goodbye"])
+    assert len(docs) == 2 and len(docs[0]) == 32
+    q = emb.embed_query("hello world")
+    assert np.allclose(q, docs[0])
+
+    bge = TransformersBgeEmbeddings(emb.model, emb.tokenizer)
+    v = bge.embed_query("hello world")
+    assert len(v) == 32 and not np.allclose(v, q)  # cls != mean pooling
+
+
+def test_vllm_stop_token_ids_with_ignore_eos(tiny_ckpt):
+    from ipex_llm_tpu.vllm import LLM, SamplingParams
+
+    llm = LLM(model=tiny_ckpt, load_in_low_bit="sym_int4", max_num_seqs=2,
+              max_model_len=256)
+    try:
+        base = llm.generate(["hello"], SamplingParams(
+            temperature=0.0, max_tokens=8, ignore_eos=True))
+        toks = base[0].outputs[0].token_ids
+        assert len(toks) >= 2
+        # stopping on the first generated token must terminate immediately
+        # even with ignore_eos=True (vLLM: ignore_eos only masks model EOS)
+        stopped = llm.generate(["hello"], SamplingParams(
+            temperature=0.0, max_tokens=8, ignore_eos=True,
+            stop_token_ids=[toks[0]]))
+        assert len(stopped[0].outputs[0].token_ids) == 1
+    finally:
+        llm.shutdown()
+
+
+def test_vllm_async_abort(tiny_ckpt):
+    import asyncio
+
+    from ipex_llm_tpu.vllm import (
+        AsyncEngineArgs,
+        AsyncLLMEngine,
+        SamplingParams,
+    )
+
+    eng = AsyncLLMEngine.from_engine_args(AsyncEngineArgs(
+        model=tiny_ckpt, max_num_seqs=2, max_model_len=256))
+
+    async def run():
+        gen = eng.generate("hello there", SamplingParams(
+            temperature=0.0, max_tokens=64, ignore_eos=True), "abort-me")
+        first = await gen.__anext__()
+        assert not first.finished
+        await eng.abort("abort-me")
+        outs = [o async for o in gen]
+        return outs[-1] if outs else first
+
+    try:
+        last = asyncio.run(run())
+        # far fewer than the 64 requested tokens actually generated
+        assert len(last.outputs[0].token_ids) < 32
+        assert "abort-me" not in eng._requests
+    finally:
+        eng._llm.shutdown()
+
+
+def test_embeddings_length_bucketing(tmp_path):
+    """Same text padded into a bucket must embed identically to itself and
+    different-length texts reuse few compiled shapes (mask-aware pooling)."""
+    from transformers import BertConfig, BertModel
+
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64)
+    torch.manual_seed(3)
+    path = str(tmp_path / "bert_bucket")
+    BertModel(cfg).eval().save_pretrained(path, safe_serialization=True)
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    vocab = {chr(i + 32): i for i in range(0, 90)}
+    vocab["<unk>"] = 90
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tok,
+                            unk_token="<unk>").save_pretrained(path)
+
+    from ipex_llm_tpu.langchain import TransformersEmbeddings
+
+    emb = TransformersEmbeddings.from_model_id(
+        path, model_kwargs={"load_in_low_bit": "bf16"})
+    a = emb.embed_query("short")           # bucket 16
+    b = emb.embed_query("short")
+    assert np.allclose(a, b)
+    long = "x" * 100                        # > max_position: truncates to 64
+    v = emb.embed_query(long)
+    assert len(v) == 32 and np.isfinite(v).all()
