@@ -162,8 +162,26 @@ def build_params(
             qw = getp(name(scheme.q, i))
             kw = getp(name(scheme.k, i))
             vw = getp(name(scheme.v, i))
-            qkv_w = np.concatenate([qw, kw, vw], axis=0)  # [out_total, in]
             bs = [get_opt(name(t, i, "bias")) for t in (scheme.q, scheme.k, scheme.v)]
+            if cfg.kv_heads_per_layer is not None:
+                # decilm variable GQA: replicate this layer's kv heads up to
+                # the uniform cache width (exact for grouped-query attention)
+                src = cfg.kv_heads_per_layer[i]
+                r = cfg.num_kv_heads // src
+                if r > 1:
+                    def _expand(w):
+                        if w is None:
+                            return None
+                        shape1 = w.shape[1:]
+                        x = w.reshape(src, cfg.head_dim, -1)
+                        return np.repeat(x, r, axis=0).reshape(
+                            (src * r * cfg.head_dim,) + shape1)
+                    kw, vw = _expand(kw), _expand(vw)
+                    bs = [bs[0]] + [
+                        None if b is None else _expand(b[:, None])[:, 0]
+                        for b in bs[1:]
+                    ]
+            qkv_w = np.concatenate([qw, kw, vw], axis=0)  # [out_total, in]
             qkv_b = np.concatenate(bs) if bs[0] is not None else None
         if not (scheme.kv_a is not None and cfg.is_mla):
             lp["qkv"] = quantize_weight(qkv_w, qtype)

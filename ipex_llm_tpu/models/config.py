@@ -74,6 +74,12 @@ class ModelConfig:
     # apply_residual_scale folds it into o_proj/down_proj; here it is a
     # config knob applied in the decoder so quantized weights stay faithful)
     residual_multiplier: float = 1.0
+    # decilm variable GQA (reference decilm.py: per-module
+    # num_key_value_heads): checkpoint kv-head counts per layer; the loader
+    # replicates kv heads up to the uniform num_kv_heads (= max) so the
+    # scan decoder keeps one homogeneous stacked cache — replication is
+    # mathematically exact for GQA
+    kv_heads_per_layer: tuple[int, ...] | None = None
 
     # MoE (mixtral / qwen-moe / deepseek-style)
     num_experts: int = 0

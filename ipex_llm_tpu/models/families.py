@@ -556,6 +556,25 @@ def _falcon(hf: dict) -> ModelConfig:
     ))
 
 
+def _decilm(hf: dict) -> ModelConfig:
+    """DeciLM: llama layout with a DIFFERENT kv-head count per layer
+    (``num_key_value_heads_per_layer``; reference decilm.py reads it off
+    each attention module).  The loader replicates kv heads up to the max
+    so the scan decoder keeps one homogeneous cache."""
+    per = hf.get("num_key_value_heads_per_layer")
+    if per:
+        per = tuple(int(x) for x in per)
+        mx = max(per)
+        for p in per:
+            if mx % p:
+                raise NotImplementedError(
+                    f"kv head counts {per} are not divisors of {mx}")
+        hf2 = dict(hf)
+        hf2["num_key_value_heads"] = mx
+        return ModelConfig(**_base_cfg(hf2, kv_heads_per_layer=per))
+    return ModelConfig(**_base_cfg(hf))
+
+
 def _internlm(hf: dict) -> ModelConfig:
     """internlm (v1): llama layout with a single ``bias`` flag covering
     q/k/v/o (reference transformers/models/internlm.py)."""
@@ -957,6 +976,9 @@ FAMILIES: dict[str, Family] = {
     # keys and weight names (reference models/aquila.py patches llama SDPA)
     "aquila": Family("aquila", _llama),
     "internlm": Family("internlm", _internlm),
+    # DeciLM-6B/7B publish model_type "deci" (some forks "deci_lm")
+    "deci": Family("deci", _decilm),
+    "deci_lm": Family("deci_lm", _decilm),
     "qwen": Family("qwen", _qwen, _QWEN_SCHEME),
     "gpt_bigcode": Family("gpt_bigcode", _gptbigcode, _GPTBIGCODE_SCHEME,
                           qkv_transform=_gptbigcode_qkv),

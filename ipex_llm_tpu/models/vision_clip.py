@@ -45,6 +45,12 @@ class ClipVisionConfig:
     feature_layer: int = -2
     select_strategy: str = "default"   # "default" drops CLS, "full" keeps
     projector_act: str = "gelu"
+    # "clip" (LLaVA): CLS token + pre-layernorm, MLP projector.
+    # "janus" (SigLIP-style): no CLS, no pre-LN, post-layernorm applied,
+    # aligner projector fc1 + (depth-1) hidden layers (reference janus.py
+    # attention patch; HF JanusVisionModel/JanusVisionAlignerMLP).
+    variant: str = "clip"
+    aligner_depth: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -81,11 +87,19 @@ def build_clip_vision_params(vc: ClipVisionConfig, get, has,
                              qtype: str) -> dict:
     from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
 
-    vt, mp = "model.vision_tower.vision_model.", "model.multi_modal_projector."
-    if not has(vt + "embeddings.class_embedding"):  # legacy submodel prefixes
-        vt, mp = "vision_tower.vision_model.", "multi_modal_projector."
-    if not has(vt + "embeddings.class_embedding"):
-        raise ValueError("no CLIP vision weights found in checkpoint")
+    if vc.variant == "janus":
+        vt, mp = "model.vision_model.", "model.aligner."
+        if not has(vt + "embeddings.patch_embedding.weight"):
+            vt, mp = "vision_model.", "aligner."
+        o_name = "self_attn.projection_layer"
+    else:
+        vt = "model.vision_tower.vision_model."
+        mp = "model.multi_modal_projector."
+        if not has(vt + "embeddings.class_embedding"):  # legacy prefixes
+            vt, mp = "vision_tower.vision_model.", "multi_modal_projector."
+        o_name = "self_attn.out_proj"
+    if not has(vt + "embeddings.patch_embedding.weight"):
+        raise ValueError("no vision tower weights found in checkpoint")
 
     def gb(lp, key, n):
         if has(n):
@@ -97,13 +111,20 @@ def build_clip_vision_params(vc: ClipVisionConfig, get, has,
         np.ascontiguousarray(pw.reshape(pw.shape[0], -1)), qtype
     )
     gb(p, "patch_bias", vt + "embeddings.patch_embedding.bias")
-    p["cls_token"] = jnp.asarray(get(vt + "embeddings.class_embedding"),
-                                 jnp.float32).reshape(1, -1)
+    if vc.variant == "clip":
+        p["cls_token"] = jnp.asarray(get(vt + "embeddings.class_embedding"),
+                                     jnp.float32).reshape(1, -1)
+        # HF's CLIPVisionTransformer attribute really is spelled
+        # "pre_layrnorm"
+        p["pre_ln"] = jnp.asarray(get(vt + "pre_layrnorm.weight"),
+                                  jnp.float32)
+        gb(p, "pre_ln_b", vt + "pre_layrnorm.bias")
+    else:
+        p["post_ln"] = jnp.asarray(get(vt + "post_layernorm.weight"),
+                                   jnp.float32)
+        gb(p, "post_ln_b", vt + "post_layernorm.bias")
     p["pos"] = jnp.asarray(get(vt + "embeddings.position_embedding.weight"),
                            jnp.float32)
-    # HF's CLIPVisionTransformer attribute really is spelled "pre_layrnorm"
-    p["pre_ln"] = jnp.asarray(get(vt + "pre_layrnorm.weight"), jnp.float32)
-    gb(p, "pre_ln_b", vt + "pre_layrnorm.bias")
     layers = []
     for i in range(vc.blocks_to_run):
         b = f"{vt}encoder.layers.{i}."
@@ -112,17 +133,36 @@ def build_clip_vision_params(vc: ClipVisionConfig, get, has,
             lp[key] = jnp.asarray(get(b + n + ".weight"), jnp.float32)
             gb(lp, key + "_b", b + n + ".bias")
         for key, n in (("q", "self_attn.q_proj"), ("k", "self_attn.k_proj"),
-                       ("v", "self_attn.v_proj"), ("o", "self_attn.out_proj"),
+                       ("v", "self_attn.v_proj"), ("o", o_name),
                        ("fc1", "mlp.fc1"), ("fc2", "mlp.fc2")):
             lp[key] = quantize_weight(get(b + n + ".weight"), qtype)
             gb(lp, key + "_b", b + n + ".bias")
+        # optional per-head q/k layernorm (janus use_qk_norm variants)
+        for key, n in (("q_norm", "self_attn.q_norm"),
+                       ("k_norm", "self_attn.k_norm")):
+            if has(b + n + ".weight"):
+                lp[key] = jnp.asarray(get(b + n + ".weight"), jnp.float32)
+                gb(lp, key + "_b", b + n + ".bias")
         layers.append(lp)
     p["blocks"] = stack_layer_trees(layers)
 
-    p["proj_fc1"] = quantize_weight(get(mp + "linear_1.weight"), qtype)
-    p["proj_fc1_b"] = jnp.asarray(get(mp + "linear_1.bias"), jnp.float32)
-    p["proj_fc2"] = quantize_weight(get(mp + "linear_2.weight"), qtype)
-    p["proj_fc2_b"] = jnp.asarray(get(mp + "linear_2.bias"), jnp.float32)
+    if vc.variant == "janus":
+        p["proj_fc1"] = quantize_weight(get(mp + "fc1.weight"), qtype)
+        p["proj_fc1_b"] = jnp.asarray(get(mp + "fc1.bias"), jnp.float32)
+        hidden = []
+        for i in range(vc.aligner_depth - 1):
+            hidden.append({
+                "w": quantize_weight(get(f"{mp}hidden_layers.{i}.weight"),
+                                     qtype),
+                "b": jnp.asarray(get(f"{mp}hidden_layers.{i}.bias"),
+                                 jnp.float32),
+            })
+        p["aligner_hidden"] = {str(i): h for i, h in enumerate(hidden)}
+    else:
+        p["proj_fc1"] = quantize_weight(get(mp + "linear_1.weight"), qtype)
+        p["proj_fc1_b"] = jnp.asarray(get(mp + "linear_1.bias"), jnp.float32)
+        p["proj_fc2"] = quantize_weight(get(mp + "linear_2.weight"), qtype)
+        p["proj_fc2_b"] = jnp.asarray(get(mp + "linear_2.bias"), jnp.float32)
     return p
 
 
@@ -137,23 +177,31 @@ def clip_vision_forward(vc: ClipVisionConfig, params: dict,
     patches = patches.reshape(b, gh * gw, c * ps * ps).astype(jnp.bfloat16)
     x = linear_ops.linear(patches, params["patch_proj"],
                           params.get("patch_bias")).astype(jnp.float32)
-    cls = jnp.broadcast_to(params["cls_token"][None], (b, 1, vc.hidden_size))
-    x = jnp.concatenate([cls, x], axis=1)
+    if vc.variant == "clip":
+        cls = jnp.broadcast_to(params["cls_token"][None],
+                               (b, 1, vc.hidden_size))
+        x = jnp.concatenate([cls, x], axis=1)
     x = x + params["pos"][None, : x.shape[1]]
-    x = layer_norm(x, params["pre_ln"], params.get("pre_ln_b"), vc.norm_eps)
+    if "pre_ln" in params:
+        x = layer_norm(x, params["pre_ln"], params.get("pre_ln_b"),
+                       vc.norm_eps)
     n = x.shape[1]
 
     def block(x, lp):
         h = layer_norm(x, lp["ln1"], lp.get("ln1_b"), vc.norm_eps)
         hb = h.astype(jnp.bfloat16)
-        q = linear_ops.linear(hb, lp["q"], lp.get("q_b"))
-        k = linear_ops.linear(hb, lp["k"], lp.get("k_b"))
+        q = linear_ops.linear(hb, lp["q"], lp.get("q_b")).astype(jnp.float32)
+        k = linear_ops.linear(hb, lp["k"], lp.get("k_b")).astype(jnp.float32)
         v = linear_ops.linear(hb, lp["v"], lp.get("v_b"))
+        q = q.reshape(b, n, vc.num_heads, vc.head_dim)
+        k = k.reshape(b, n, vc.num_heads, vc.head_dim)
+        if "q_norm" in lp:   # janus use_qk_norm: LayerNorm over head_dim
+            q = layer_norm(q, lp["q_norm"], lp.get("q_norm_b"), vc.norm_eps)
+            k = layer_norm(k, lp["k_norm"], lp.get("k_norm_b"), vc.norm_eps)
         from ipex_llm_tpu.ops.attention import sdpa_reference
 
         attn = sdpa_reference(
-            q.reshape(b, n, vc.num_heads, vc.head_dim),
-            k.reshape(b, n, vc.num_heads, vc.head_dim),
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
             v.reshape(b, n, vc.num_heads, vc.head_dim),
             causal=False,
         ).reshape(b, n, vc.hidden_size)
@@ -169,8 +217,21 @@ def clip_vision_forward(vc: ClipVisionConfig, params: dict,
         return x, None
 
     x, _ = jax.lax.scan(block, x, params["blocks"])
+    if "post_ln" in params:  # janus applies the final layernorm
+        x = layer_norm(x, params["post_ln"], params.get("post_ln_b"),
+                       vc.norm_eps)
 
     feats = x[:, 1:] if vc.select_strategy == "default" else x
+    if vc.variant == "janus":
+        # aligner (JanusVisionAlignerMLP): h = fc1(x); per extra depth step
+        # h = hidden_i(act(h)) — activation BETWEEN layers, none at the end
+        h = linear_ops.linear(feats.astype(jnp.bfloat16), params["proj_fc1"],
+                              params["proj_fc1_b"])
+        for i in range(vc.aligner_depth - 1):
+            hl = params["aligner_hidden"][str(i)]
+            h = linear_ops.linear(mlp_ops.act(h, vc.projector_act),
+                                  hl["w"], hl["b"])
+        return h
     h = mlp_ops.act(
         linear_ops.linear(feats.astype(jnp.bfloat16), params["proj_fc1"],
                           params["proj_fc1_b"]), vc.projector_act,

@@ -31,18 +31,29 @@ def _now() -> int:
 
 
 class OpenAIServer:
-    def __init__(self, engine: ServingEngine, tokenizer, model_name: str):
+    def __init__(self, engine: ServingEngine, tokenizer, model_name: str,
+                 asr=None):
         if web is None:  # pragma: no cover
             raise ImportError(f"aiohttp is required for serving: {_AIOHTTP_ERR}")
         self.engine = engine
         self.tok = tokenizer
         self.model_name = model_name
+        # asr = (whisper model, feature extractor, tokenizer) enabling the
+        # OpenAI audio surface (the reference serves whisper through its
+        # workers; SURVEY L6 lists the audio endpoint)
+        self.asr = asr
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat)
         self.app.router.add_post("/v1/completions", self.completions)
         self.app.router.add_get("/v1/models", self.models)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/metrics", self.metrics)
+        # TGI-protocol surface (reference serving/fastchat/tgi_api_server.py)
+        self.app.router.add_post("/generate", self.tgi_generate)
+        self.app.router.add_post("/generate_stream", self.tgi_generate_stream)
+        if asr is not None:
+            self.app.router.add_post("/v1/audio/transcriptions",
+                                     self.transcriptions)
 
     # -- helpers ------------------------------------------------------------
 
@@ -85,6 +96,14 @@ class OpenAIServer:
         hits = [text.find(s) for s in stops if s and text.find(s) >= 0]
         return min(hits) if hits else -1
 
+    # Internal finish reasons: engine "stop" (EOS) / "length" / "abort",
+    # plus server-side "stop_string" for stop-sequence truncation.  The
+    # OpenAI surface maps stop_string -> "stop"; the TGI surface maps
+    # stop -> "eos_token" and stop_string -> "stop_sequence".
+    @staticmethod
+    def _openai_reason(fr: str | None) -> str | None:
+        return "stop" if fr == "stop_string" else fr
+
     async def _collect(self, req: Request) -> str:
         loop = asyncio.get_running_loop()
         toks: list[int] = []
@@ -102,11 +121,17 @@ class OpenAIServer:
                 cut = self._find_stop(text, stops)
                 if cut >= 0:
                     self.engine.abort(req)
-                    req.finish_reason = "stop"
+                    req.finish_reason = "stop_string"
                     return text[:cut]
         return self.tok.decode(toks)
 
-    async def _stream_sse(self, request, req: Request, chunk_fn):
+    async def _stream_sse(self, request, req: Request, chunk_fn,
+                          final_fn=None, send_done: bool = True):
+        """Shared SSE streaming loop (OpenAI and TGI surfaces).
+
+        ``chunk_fn(piece, finish, tok)`` renders one incremental event;
+        ``final_fn(sent_text, finish_reason)`` (optional) renders the
+        terminal event instead of ``chunk_fn("", finish, None)``."""
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -134,16 +159,18 @@ class OpenAIServer:
                 if piece:
                     sent += piece
                     await resp.write(
-                        f"data: {json.dumps(chunk_fn(piece, None))}\n\n".encode()
+                        f"data: {json.dumps(chunk_fn(piece, None, tok))}\n\n"
+                        .encode()
                     )
                 if done:
                     self.engine.abort(req)
-                    req.finish_reason = "stop"
+                    req.finish_reason = "stop_string"
                     break
-            await resp.write(
-                f"data: {json.dumps(chunk_fn('', req.finish_reason))}\n\n".encode()
-            )
-            await resp.write(b"data: [DONE]\n\n")
+            final = (final_fn(sent, req.finish_reason) if final_fn
+                     else chunk_fn("", req.finish_reason, None))
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            if send_done:
+                await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: free the engine row instead of decoding on
@@ -165,13 +192,14 @@ class OpenAIServer:
         rid = f"chatcmpl-{req.request_id[:12]}"
 
         if body.get("stream"):
-            def chunk(piece: str, finish):
+            def chunk(piece: str, finish, tok=None):
                 delta = {"content": piece} if piece else {}
                 return {
                     "id": rid, "object": "chat.completion.chunk",
                     "created": _now(), "model": self.model_name,
                     "choices": [{"index": 0, "delta": delta,
-                                 "finish_reason": finish}],
+                                 "finish_reason":
+                                     self._openai_reason(finish)}],
                 }
             return await self._stream_sse(request, req, chunk)
 
@@ -182,7 +210,7 @@ class OpenAIServer:
             "choices": [{
                 "index": 0,
                 "message": {"role": "assistant", "content": text},
-                "finish_reason": req.finish_reason,
+                "finish_reason": self._openai_reason(req.finish_reason),
             }],
             "usage": {
                 "prompt_tokens": len(req.prompt_ids),
@@ -228,12 +256,13 @@ class OpenAIServer:
         rid = f"cmpl-{req.request_id[:12]}"
 
         if body.get("stream"):
-            def chunk(piece: str, finish):
+            def chunk(piece: str, finish, tok=None):
                 return {
                     "id": rid, "object": "text_completion", "created": _now(),
                     "model": self.model_name,
                     "choices": [{"index": 0, "text": piece,
-                                 "finish_reason": finish}],
+                                 "finish_reason":
+                                     self._openai_reason(finish)}],
                 }
             return await self._stream_sse(request, req, chunk)
 
@@ -242,7 +271,8 @@ class OpenAIServer:
             "id": rid, "object": "text_completion", "created": _now(),
             "model": self.model_name,
             "choices": [{"index": 0, "text": text,
-                         "finish_reason": req.finish_reason}],
+                         "finish_reason":
+                             self._openai_reason(req.finish_reason)}],
             "usage": {
                 "prompt_tokens": len(req.prompt_ids),
                 "completion_tokens": len(req.output_ids),
@@ -263,10 +293,131 @@ class OpenAIServer:
     async def metrics(self, request):
         return web.json_response(dict(self.engine.metrics))
 
+    # -- TGI protocol -------------------------------------------------------
+
+    def _tgi_request(self, body: dict) -> Request:
+        """TGI shape: {"inputs": str, "parameters": {...}} (reference
+        tgi_api_protocol.py ChatCompletionParam)."""
+        p = body.get("parameters") or {}
+        mapped = {
+            "max_tokens": p.get("max_new_tokens", 64),
+            "temperature": (p.get("temperature", 1.0)
+                            if p.get("do_sample", False) else 0.0),
+            "top_p": p.get("top_p", 1.0),
+            "stop": p.get("stop"),
+        }
+        ids = list(self.tok(body.get("inputs", ""))["input_ids"])
+        return self._mk_request(mapped, ids)
+
+    @staticmethod
+    def _tgi_reason(fr: str | None) -> str:
+        return {"stop": "eos_token", "stop_string": "stop_sequence"}.get(
+            fr, fr or "length")
+
+    async def tgi_generate(self, request):
+        body = await request.json()
+        req = self.engine.submit(self._tgi_request(body))
+        text = await self._collect(req)
+        return web.json_response({
+            "generated_text": text,
+            "details": {
+                "finish_reason": self._tgi_reason(req.finish_reason),
+                "generated_tokens": len(req.output_ids),
+                "prefill": [],
+            },
+        })
+
+    async def tgi_generate_stream(self, request):
+        body = await request.json()
+        req = self.engine.submit(self._tgi_request(body))
+
+        def chunk(piece, finish, tok):
+            return {"token": {"id": int(tok), "text": piece,
+                              "special": False},
+                    "generated_text": None}
+
+        def final(sent, finish):
+            return {"token": None, "generated_text": sent,
+                    "details": {"finish_reason": self._tgi_reason(finish),
+                                "generated_tokens": len(req.output_ids)}}
+
+        return await self._stream_sse(request, req, chunk, final_fn=final,
+                                      send_done=False)
+
+    # -- audio (whisper) ----------------------------------------------------
+
+    async def transcriptions(self, request):
+        """OpenAI /v1/audio/transcriptions: multipart WAV in, text out."""
+        import asyncio
+
+        form = await request.post()
+        part = form.get("file")
+        if part is None:
+            return web.json_response(
+                {"error": {"message": "missing 'file' form field"}},
+                status=400)
+        data = part.file.read()
+        asr_model, fe, asr_tok = self.asr
+
+        def pipeline():
+            """WAV decode + resample + mel features + generate — all off
+            the event loop so concurrent SSE streams never stall."""
+            import numpy as np
+
+            samples, sr = _read_wav(data)
+            want_sr = getattr(fe, "sampling_rate", 16000)
+            if sr != want_sr:  # linear resample (no audio stack in image)
+                n = int(len(samples) * want_sr / sr)
+                samples = np.interp(
+                    np.linspace(0, len(samples) - 1, n),
+                    np.arange(len(samples)), samples).astype("float32")
+            feats = fe(samples, sampling_rate=want_sr,
+                       return_tensors="np")["input_features"]
+            # the extractor pads to 30 s; clip to the encoder window
+            feats = feats[:, :, :2 * asr_model.config.max_source_positions]
+            return asr_model.generate(feats, max_new_tokens=224)
+
+        loop = asyncio.get_running_loop()
+        try:
+            ids = await loop.run_in_executor(None, pipeline)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"only PCM WAV input is supported "
+                                      f"in this build ({e})"}}, status=400)
+        text = asr_tok.decode(list(map(int, ids[0])),
+                              skip_special_tokens=True)
+        return web.json_response({"text": text})
+
+
+def _read_wav(data: bytes):
+    """stdlib PCM WAV decode -> (float32 mono samples, sample_rate)."""
+    import io
+    import wave
+
+    import numpy as np
+
+    with wave.open(io.BytesIO(data), "rb") as w:
+        sw = w.getsampwidth()
+        nch = w.getnchannels()
+        sr = w.getframerate()
+        raw = w.readframes(w.getnframes())
+    if sw == 2:
+        x = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif sw == 4:
+        x = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    elif sw == 1:
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported sample width {sw}")
+    if nch > 1:
+        x = x.reshape(-1, nch).mean(axis=1)
+    return x, sr
+
 
 def build_server(model_path: str, low_bit: str = "sym_int4",
                  engine_config: EngineConfig | None = None,
-                 model=None, tokenizer=None) -> OpenAIServer:
+                 model=None, tokenizer=None,
+                 asr_model_path: str | None = None) -> OpenAIServer:
     from ipex_llm_tpu.transformers import AutoModelForCausalLM
 
     if model is None:
@@ -287,7 +438,21 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
         model.config, model.params, engine_config,
         default_eos=model.generation_config.eos_token_id,
     ).start()
-    return OpenAIServer(engine, tokenizer, model_name=model_path)
+    asr = None
+    if asr_model_path is not None:
+        from transformers import AutoFeatureExtractor, AutoTokenizer
+
+        from ipex_llm_tpu.models.whisper import (
+            TPUWhisperForConditionalGeneration,
+        )
+
+        asr = (
+            TPUWhisperForConditionalGeneration.from_pretrained(
+                asr_model_path, load_in_low_bit=low_bit),
+            AutoFeatureExtractor.from_pretrained(asr_model_path),
+            AutoTokenizer.from_pretrained(asr_model_path),
+        )
+    return OpenAIServer(engine, tokenizer, model_name=model_path, asr=asr)
 
 
 def main(argv=None):
@@ -298,10 +463,13 @@ def main(argv=None):
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-rows", type=int, default=16)
     ap.add_argument("--max-seq-len", type=int, default=4096)
+    ap.add_argument("--asr-model", default=None,
+                    help="whisper checkpoint enabling /v1/audio/transcriptions")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
         EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len),
+        asr_model_path=args.asr_model,
     )
     web.run_app(srv.app, host=args.host, port=args.port)
 

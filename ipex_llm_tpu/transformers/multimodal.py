@@ -489,9 +489,76 @@ class TPULlavaForConditionalGeneration(TPUInternVLForConditionalGeneration):
         return m
 
 
+def _janus_vision_cfg(hf_config: dict):
+    from ipex_llm_tpu.models.vision_clip import ClipVisionConfig
+
+    v = hf_config["vision_config"]
+    return ClipVisionConfig(
+        hidden_size=v["hidden_size"],
+        num_layers=v["num_hidden_layers"],
+        num_heads=v["num_attention_heads"],
+        intermediate_size=v.get("intermediate_size") or int(
+            v.get("mlp_ratio", 4.0) * v["hidden_size"]),
+        patch_size=v.get("patch_size", 16),
+        image_size=v.get("image_size", 384),
+        norm_eps=v.get("layer_norm_eps", 1e-6),
+        act=v.get("hidden_act", "gelu"),
+        feature_layer=v["num_hidden_layers"],   # full tower
+        select_strategy="full",                  # no CLS to drop
+        projector_act=v.get("hidden_act", "gelu"),
+        variant="janus",
+        aligner_depth=v.get("depth", 2),
+    )
+
+
+class TPUJanusForConditionalGeneration(TPULlavaForConditionalGeneration):
+    """Janus (image understanding path): SigLIP-style tower + aligner MLP +
+    llama text via embed replacement.
+
+    Reference counterpart: transformers/models/janus.py (vision SDPA patch).
+    The image-GENERATION path (VQ-VAE token head) is not implemented — this
+    covers the multimodal-understanding direction the reference accelerates."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_clip import build_clip_vision_params
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        text = dict(hf_config["text_config"])
+        fam = get_family(text.get("model_type", "llama"))
+        cfg = fam.to_config(text)
+        vcfg = _janus_vision_cfg(hf_config)
+        reader = _AliasReader(CheckpointReader(path))
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_clip_vision_params(
+            vcfg, reader.reader.get, reader.reader.has, qtype
+        )
+        m = cls(cfg, vcfg, params, vparams, hf_config, qtype)
+        m.image_token_id = hf_config.get("image_token_id", 100581)
+        return m
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.families import get_family
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        text = dict(hf["text_config"])
+        cfg = get_family(text.get("model_type", "llama")).to_config(text)
+        m = cls(cfg, _janus_vision_cfg(hf), tree["text"], tree["vision"],
+                hf, qtype)
+        m.image_token_id = hf.get("image_token_id", 100581)
+        return m
+
+
 class AutoModelForVision2Seq:
     """Vision-language loader dispatching by model_type (qwen2_vl,
-    internvl, llava)."""
+    internvl, llava, mllama, janus)."""
 
     @classmethod
     def from_pretrained(cls, path: str, **kwargs):
@@ -514,9 +581,13 @@ class AutoModelForVision2Seq:
             return TPUMllamaForConditionalGeneration.from_pretrained(
                 str(path), **kwargs
             )
+        if mt == "janus":
+            return TPUJanusForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
         raise ValueError(
-            f"AutoModelForVision2Seq supports qwen2_vl/internvl/llava/mllama; "
-            f"got {mt!r}"
+            f"AutoModelForVision2Seq supports qwen2_vl/internvl/llava/"
+            f"mllama/janus; got {mt!r}"
         )
 
     @classmethod
@@ -534,6 +605,8 @@ class AutoModelForVision2Seq:
             return TPUInternVLForConditionalGeneration.load_low_bit(str(path))
         if mt == "llava":
             return TPULlavaForConditionalGeneration.load_low_bit(str(path))
+        if mt == "janus":
+            return TPUJanusForConditionalGeneration.load_low_bit(str(path))
         if mt == "mllama":
             from ipex_llm_tpu.models.mllama import (
                 TPUMllamaForConditionalGeneration,

@@ -310,3 +310,192 @@ def test_embeddings_length_bucketing(tmp_path):
     long = "x" * 100                        # > max_position: truncates to 64
     v = emb.embed_query(long)
     assert len(v) == 32 and np.isfinite(v).all()
+
+
+def test_openai_audio_transcriptions(tmp_path):
+    """OpenAI /v1/audio/transcriptions over the whisper family (closes the
+    'no audio endpoint' L6 gap)."""
+    import asyncio
+    import io
+    import wave
+
+    from aiohttp.test_utils import TestClient, TestServer
+    from transformers import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        WhisperConfig,
+        WhisperFeatureExtractor,
+        WhisperForConditionalGeneration,
+    )
+
+    # tiny text model for the chat engine
+    text_path = str(tmp_path / "text")
+    torch.manual_seed(0)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False)).eval().save_pretrained(
+            text_path, safe_serialization=True)
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    vocab = {chr(i + 32): i for i in range(0, 224)}
+    vocab["<unk>"] = 224
+    vocab["</s>"] = 225
+    tk = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    fast = PreTrainedTokenizerFast(tokenizer_object=tk, unk_token="<unk>",
+                                   eos_token="</s>")
+    fast.save_pretrained(text_path)
+
+    # tiny whisper + feature extractor + (char) tokenizer
+    asr_path = str(tmp_path / "asr")
+    torch.manual_seed(1)
+    WhisperForConditionalGeneration(WhisperConfig(
+        vocab_size=200, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=16,
+        max_source_positions=75, max_target_positions=64,
+        decoder_start_token_id=2, eos_token_id=3, pad_token_id=0,
+        bos_token_id=1, suppress_tokens=None, begin_suppress_tokens=None,
+    )).eval().save_pretrained(asr_path, safe_serialization=True)
+    WhisperFeatureExtractor(feature_size=16).save_pretrained(asr_path)
+    fast.save_pretrained(asr_path)
+
+    from ipex_llm_tpu.serving.api_server import build_server
+    from ipex_llm_tpu.serving.engine import EngineConfig
+
+    srv = build_server(text_path, low_bit="sym_int4",
+                       engine_config=EngineConfig(max_rows=2,
+                                                  max_seq_len=128),
+                       asr_model_path=asr_path)
+
+    # 0.5 s of 440 Hz PCM16 WAV at 8 kHz (exercises the resample path)
+    sr = 8000
+    t = np.arange(sr // 2) / sr
+    pcm = (np.sin(2 * np.pi * 440 * t) * 20000).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+    wav_bytes = buf.getvalue()
+
+    async def run():
+        async with TestClient(TestServer(srv.app)) as client:
+            import aiohttp
+
+            form = aiohttp.FormData()
+            form.add_field("file", wav_bytes, filename="a.wav",
+                           content_type="audio/wav")
+            form.add_field("model", "whisper-tiny")
+            r = await client.post("/v1/audio/transcriptions", data=form)
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert "text" in body and isinstance(body["text"], str)
+
+            # non-WAV input fails with a clear 400, not a 500
+            bad = aiohttp.FormData()
+            bad.add_field("file", b"not a wav", filename="b.mp3")
+            r2 = await client.post("/v1/audio/transcriptions", data=bad)
+            assert r2.status == 400
+            return True
+
+    try:
+        assert asyncio.run(run())
+    finally:
+        srv.engine.stop()
+
+
+def test_tgi_protocol_endpoints(tiny_ckpt):
+    """TGI /generate + /generate_stream (reference tgi_api_server.py)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ipex_llm_tpu.serving.api_server import build_server
+    from ipex_llm_tpu.serving.engine import EngineConfig
+
+    srv = build_server(tiny_ckpt, low_bit="sym_int4",
+                       engine_config=EngineConfig(max_rows=2,
+                                                  max_seq_len=128))
+
+    async def run():
+        async with TestClient(TestServer(srv.app)) as client:
+            r = await client.post("/generate", json={
+                "inputs": "hello",
+                "parameters": {"max_new_tokens": 5, "do_sample": False},
+            })
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert isinstance(body["generated_text"], str)
+            assert body["details"]["generated_tokens"] >= 1
+            assert body["details"]["finish_reason"] in (
+                "eos_token", "length", "stop", "abort")
+
+            r = await client.post("/generate_stream", json={
+                "inputs": "hello",
+                "parameters": {"max_new_tokens": 5, "do_sample": False},
+            })
+            raw = (await r.read()).decode()
+            events = [json.loads(line[len("data: "):])
+                      for line in raw.split("\n\n") if line.startswith("data: ")]
+            assert events[-1]["generated_text"] is not None
+            token_events = [e for e in events if e.get("token")]
+            assert all("text" in e["token"] for e in token_events)
+            # streamed pieces concatenate to the final text
+            joined = "".join(e["token"]["text"] for e in token_events)
+            assert joined == events[-1]["generated_text"]
+            return True
+
+    try:
+        assert asyncio.run(run())
+    finally:
+        srv.engine.stop()
+
+
+def test_tgi_stop_sequence_reason(tiny_ckpt):
+    """Stop-string truncation must surface TGI's 'stop_sequence', not
+    'eos_token'."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ipex_llm_tpu.serving.api_server import build_server
+    from ipex_llm_tpu.serving.engine import EngineConfig
+
+    srv = build_server(tiny_ckpt, low_bit="sym_int4",
+                       engine_config=EngineConfig(max_rows=2,
+                                                  max_seq_len=128))
+
+    async def run():
+        # learn the greedy continuation, then stop on its first char
+        r = await client_post(client, {"inputs": "hello", "parameters":
+                                       {"max_new_tokens": 4,
+                                        "do_sample": False}})
+        first = r["generated_text"][:1]
+        assert first
+        r2 = await client_post(client, {"inputs": "hello", "parameters":
+                                        {"max_new_tokens": 4,
+                                         "do_sample": False,
+                                         "stop": [first]}})
+        assert r2["generated_text"] == ""
+        assert r2["details"]["finish_reason"] == "stop_sequence"
+        return True
+
+    async def client_post(c, body):
+        resp = await c.post("/generate", json=body)
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    async def main():
+        global client
+        async with TestClient(TestServer(srv.app)) as c:
+            globals()["client"] = c
+            return await run()
+
+    try:
+        assert asyncio.run(main())
+    finally:
+        srv.engine.stop()

@@ -227,3 +227,41 @@ def test_minicpm3_mla_matches_deepseek(tmp_path):
     path = _save_synthetic(tmp_path, "minicpm3", config, sd)
     got = _load_logits(path)
     assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_decilm_variable_gqa(tmp_path):
+    """DeciLM per-layer kv-head counts: a checkpoint whose layer 1 stores
+    kv heads already replicated 2->4 must equal the original llama (kv
+    replication is exact for GQA), exercising the loader's expansion of
+    layer 0 (stored with 2 heads) up to the uniform 4."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False, max_position_embeddings=256,
+    )
+    torch.manual_seed(13)
+    hf = LlamaForCausalLM(cfg).eval()
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+    with torch.no_grad():
+        want = hf(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+
+    hd = 16
+    tensors = dict(sd)
+    # replicate layer 1's kv heads in the stored checkpoint: 2 -> 4
+    for nm in ("k_proj", "v_proj"):
+        w = sd[f"model.layers.1.self_attn.{nm}.weight"]
+        x = w.reshape(2, hd, -1)
+        tensors[f"model.layers.1.self_attn.{nm}.weight"] = (
+            np.repeat(x, 2, axis=0).reshape(4 * hd, -1))
+    config = {
+        "model_type": "deci", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads_per_layer": [2, 4],
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+    }
+    path = _save_synthetic(tmp_path, "decilm", config, tensors)
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06

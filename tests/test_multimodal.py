@@ -704,3 +704,94 @@ def test_mllama_save_load_low_bit_and_guards(tiny_mllama, tmp_path):
     with pytest.raises(NotImplementedError):
         m.forward_logits(ids, pixel_values=np.zeros((1, 2, 4, 3, 16, 16),
                                                     np.float32))
+
+
+# ---------------------------------------------------------------------------
+# janus (SigLIP tower + aligner, understanding path) — reference
+# transformers/models/janus.py
+# ---------------------------------------------------------------------------
+
+
+def test_janus_logits_parity(tmp_path):
+    from transformers import JanusConfig, JanusForConditionalGeneration
+
+    cfg = JanusConfig(
+        text_config=dict(model_type="llama", vocab_size=150, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=256,
+                         tie_word_embeddings=False),
+        vision_config=dict(hidden_size=32, num_hidden_layers=2,
+                           num_attention_heads=2, image_size=16, patch_size=4,
+                           mlp_ratio=2.0, projection_dim=64, depth=2),
+        vq_config=dict(embed_dim=8, num_embeddings=16, base_channels=32,
+                       latent_channels=32, image_token_embed_dim=16,
+                       num_patches=4),
+        image_token_id=149,
+    )
+    torch.manual_seed(0)
+    hf = JanusForConditionalGeneration(cfg).eval()
+    path = str(tmp_path / "janus")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    rng = np.random.default_rng(13)
+    pixels = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    # 16 patches -> 16 image tokens
+    ids = np.asarray([5, 9] + [149] * 16 + [7, 11, 13], np.int32)
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(ids[None].astype(np.int64)),
+            pixel_values=torch.from_numpy(pixels),
+        ).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m.forward_logits(ids, pixel_values=pixels))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+    # text-only path through the same class
+    ids_t = np.asarray([5, 9, 7, 11, 13], np.int32)
+    with torch.no_grad():
+        want_t = hf(input_ids=torch.from_numpy(ids_t[None].astype(np.int64))
+                    ).logits.float().numpy()
+    got_t = np.asarray(m.forward_logits(ids_t))
+    assert np.abs(got_t - want_t).max() / np.abs(want_t).max() < 0.06
+
+
+def test_janus_save_load_low_bit(tmp_path):
+    from transformers import JanusConfig, JanusForConditionalGeneration
+
+    cfg = JanusConfig(
+        text_config=dict(model_type="llama", vocab_size=150, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=256,
+                         tie_word_embeddings=False),
+        vision_config=dict(hidden_size=32, num_hidden_layers=2,
+                           num_attention_heads=2, image_size=16, patch_size=4,
+                           mlp_ratio=2.0, projection_dim=64, depth=2),
+        vq_config=dict(embed_dim=8, num_embeddings=16, base_channels=32,
+                       latent_channels=32, image_token_embed_dim=16,
+                       num_patches=4),
+        image_token_id=149,
+    )
+    torch.manual_seed(1)
+    path = str(tmp_path / "janus_lb_src")
+    JanusForConditionalGeneration(cfg).eval().save_pretrained(
+        path, safe_serialization=True)
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="sym_int4")
+    rng = np.random.default_rng(14)
+    pixels = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    ids = np.asarray([5, 9] + [149] * 16 + [7], np.int32)
+    want = np.asarray(m.forward_logits(ids, pixel_values=pixels))
+    out = str(tmp_path / "janus_lb")
+    m.save_low_bit(out)
+    m2 = AutoModelForVision2Seq.load_low_bit(out)
+    got = np.asarray(m2.forward_logits(ids, pixel_values=pixels))
+    assert np.allclose(got, want, atol=1e-3)
