@@ -25,7 +25,8 @@ Public API mirrors the reference's compatibility contract:
 
 __version__ = "0.1.0"
 
-__all__ = ["optimize_model", "load_low_bit", "low_memory_init", "__version__"]
+__all__ = ["optimize_model", "load_low_bit", "low_memory_init",
+           "llm_patch", "llm_unpatch", "__version__"]
 
 
 def _init_compilation_cache() -> None:
@@ -87,4 +88,8 @@ def __getattr__(name):
         from ipex_llm_tpu import optimize
 
         return getattr(optimize, name)
+    if name in ("llm_patch", "llm_unpatch"):
+        from ipex_llm_tpu import llm_patching
+
+        return getattr(llm_patching, name)
     raise AttributeError(name)

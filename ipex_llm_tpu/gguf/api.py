@@ -29,11 +29,60 @@ NORM_DTYPE = jnp.float32
 #: architectures sharing the llama-style GGUF tensor naming
 _SUPPORTED_ARCH = ("llama", "mistral", "qwen2", "qwen3", "phi3", "gemma",
                    "gemma2", "starcoder2", "internlm2")
+#: fused-qkv, non-gated-MLP architectures (llama.cpp's converters normalize
+#: attn_qkv to the standard [q_all; k; v] concat, so no re-interleave here)
+_FUSED_ARCH = ("falcon", "bloom", "mpt", "gpt2")
+
+
+def _fused_config(rd: GGUFReader, arch: str) -> ModelConfig:
+    """Build the ModelConfig through the matching family converter (reuses
+    the tested HF-config normalization in models/families.py)."""
+    from ipex_llm_tpu.models.families import get_family
+
+    md = rd.metadata
+
+    def g(key: str, default=None):
+        return md.get(f"{arch}.{key}", default)
+
+    hidden = int(g("embedding_length"))
+    heads = int(g("attention.head_count"))
+    layers = int(g("block_count"))
+    ffn = int(g("feed_forward_length", 4 * hidden))
+    vocab = int(rd.tensors["token_embd.weight"].shape[0])
+    ctx = int(g("context_length", 2048))
+    eps = float(g("attention.layer_norm_epsilon", 1e-5))
+    if arch == "falcon":
+        kv = int(g("attention.head_count_kv", 1))
+        hf = {"model_type": "falcon", "vocab_size": vocab,
+              "hidden_size": hidden, "num_hidden_layers": layers,
+              "num_attention_heads": heads, "num_kv_heads": kv,
+              "new_decoder_architecture": kv > 1, "multi_query": kv == 1,
+              "layer_norm_epsilon": eps, "ffn_hidden_size": ffn,
+              "max_position_embeddings": ctx,
+              "rope_theta": float(g("rope.freq_base", 10000.0)),
+              "parallel_attn": True, "bias": False, "alibi": False}
+    elif arch == "bloom":
+        hf = {"model_type": "bloom", "vocab_size": vocab,
+              "hidden_size": hidden, "n_layer": layers, "n_head": heads,
+              "intermediate_size": ffn, "layer_norm_epsilon": eps}
+    elif arch == "mpt":
+        hf = {"model_type": "mpt", "vocab_size": vocab, "d_model": hidden,
+              "n_layers": layers, "n_heads": heads,
+              "expansion_ratio": ffn / hidden, "layer_norm_epsilon": eps,
+              "max_seq_len": ctx,
+              "attn_config": {"alibi": True}}
+    else:  # gpt2
+        hf = {"model_type": "gpt2", "vocab_size": vocab, "n_embd": hidden,
+              "n_layer": layers, "n_head": heads, "n_inner": ffn,
+              "layer_norm_epsilon": eps, "n_positions": ctx}
+    return get_family(arch).to_config(hf)
 
 
 def _meta_config(rd: GGUFReader) -> ModelConfig:
     md = rd.metadata
     arch = md.get("general.architecture", "llama")
+    if arch in _FUSED_ARCH:
+        return _fused_config(rd, arch)
     if arch not in _SUPPORTED_ARCH:
         raise NotImplementedError(f"GGUF architecture {arch!r}")
 
@@ -73,9 +122,20 @@ _LAYER_SLOTS = {
     "q": "attn_q", "k": "attn_k", "v": "attn_v", "o": "attn_output",
     "gate": "ffn_gate", "up": "ffn_up", "down": "ffn_down",
 }
+#: fused-qkv archs: one attn_qkv tensor, no gate branch
+_FUSED_SLOTS = {
+    "qkv": "attn_qkv", "o": "attn_output",
+    "up": "ffn_up", "down": "ffn_down",
+}
 _LAYER_NORMS = {
     "attn_norm": "attn_norm", "mlp_norm": "ffn_norm",
     "q_norm": "attn_q_norm", "k_norm": "attn_k_norm",
+}
+#: fused archs use LayerNorms named attn_norm / (attn_norm_2|ffn_norm); the
+#: parallel-residual falcon shares attn_norm for both branches
+_FUSED_NORMS = {
+    "attn_norm": ("attn_norm",),
+    "mlp_norm": ("ffn_norm", "attn_norm_2", "attn_norm"),
 }
 
 
@@ -93,52 +153,59 @@ def load_gguf_model(path: str) -> tuple[ModelConfig, dict[str, Any], dict]:
     """Parse + repack a GGUF file.  Returns (cfg, params, hf_config_dict)."""
     rd = GGUFReader(path)
     cfg = _meta_config(rd)
+    fused = rd.metadata.get("general.architecture") in _FUSED_ARCH
+    slots = _FUSED_SLOTS if fused else _LAYER_SLOTS
+
+    def dense(name, dt=NORM_DTYPE):
+        info = rd.tensors[name]
+        return jnp.asarray(
+            gconv.to_dense(rd.raw(name), info.shape, rd.astype_name(name)),
+            dt)
 
     layers: list[dict[str, Any]] = []
     for i in range(cfg.num_layers):
         lp: dict[str, Any] = {}
-        for key, stem in _LAYER_NORMS.items():
-            name = f"blk.{i}.{stem}.weight"
-            if name in rd.tensors:
-                info = rd.tensors[name]
-                lp[key] = jnp.asarray(
-                    gconv.to_dense(rd.raw(name), info.shape,
-                                   rd.astype_name(name)),
-                    NORM_DTYPE,
-                )
-        for key, stem in _LAYER_SLOTS.items():
+        if fused:
+            for key, cands in _FUSED_NORMS.items():
+                for stem in cands:
+                    name = f"blk.{i}.{stem}.weight"
+                    if name in rd.tensors:
+                        lp[key] = dense(name)
+                        if f"blk.{i}.{stem}.bias" in rd.tensors:
+                            lp[key + "_bias"] = dense(
+                                f"blk.{i}.{stem}.bias")
+                        break
+        else:
+            for key, stem in _LAYER_NORMS.items():
+                name = f"blk.{i}.{stem}.weight"
+                if name in rd.tensors:
+                    lp[key] = dense(name)
+        for key, stem in slots.items():
             name = f"blk.{i}.{stem}.weight"
             lp[key] = _load_qtensor(rd, name)
             bias = f"blk.{i}.{stem}.bias"
             if bias in rd.tensors:
-                binfo = rd.tensors[bias]
-                lp[key + "_bias"] = jnp.asarray(
-                    gconv.to_dense(rd.raw(bias), binfo.shape,
-                                   rd.astype_name(bias)),
-                    jnp.float32,
-                )
+                lp[key + "_bias"] = dense(bias, jnp.float32)
         layers.append(lp)
 
     # homogenize per-slot qtypes across layers (scan needs one layout)
-    for key in _LAYER_SLOTS:
+    for key in slots:
         qtypes_seen = {layers[i][key].qtype for i in range(cfg.num_layers)}
         if len(qtypes_seen) > 1:
             for i in range(cfg.num_layers):
                 layers[i][key] = _requantize(layers[i][key], "sym_int8")
 
     params: dict[str, Any] = {"layers": stack_layer_trees(layers)}
-    emb_info = rd.tensors["token_embd.weight"]
-    params["embed"] = jnp.asarray(
-        gconv.to_dense(rd.raw("token_embd.weight"), emb_info.shape,
-                       rd.astype_name("token_embd.weight")),
-        jnp.bfloat16,
-    )
-    norm_info = rd.tensors["output_norm.weight"]
-    params["final_norm"] = jnp.asarray(
-        gconv.to_dense(rd.raw("output_norm.weight"), norm_info.shape,
-                       rd.astype_name("output_norm.weight")),
-        NORM_DTYPE,
-    )
+    params["embed"] = dense("token_embd.weight", jnp.bfloat16)
+    if "token_embd_norm.weight" in rd.tensors:   # bloom embedding layernorm
+        params["embed_norm"] = dense("token_embd_norm.weight")
+        if "token_embd_norm.bias" in rd.tensors:
+            params["embed_norm_bias"] = dense("token_embd_norm.bias")
+    if "position_embd.weight" in rd.tensors:     # gpt2 learned positions
+        params["pos_embed"] = dense("position_embd.weight", jnp.bfloat16)
+    params["final_norm"] = dense("output_norm.weight")
+    if "output_norm.bias" in rd.tensors:
+        params["final_norm_bias"] = dense("output_norm.bias")
     if not cfg.tie_word_embeddings:
         params["lm_head"] = _load_qtensor(rd, "output.weight")
     if cfg.rope is not None:

@@ -1,0 +1,72 @@
+"""One-line patching of existing HF scripts onto the TPU stack.
+
+Reference counterpart: ``llm_patch``/``llm_unpatch`` (reference
+llm_patching.py:35-88) — swap ``transformers.AutoModelForCausalLM`` and
+friends for the low-bit drop-in classes so an unmodified user script picks
+up the optimized path with one call:
+
+    from ipex_llm_tpu import llm_patch
+    llm_patch()
+    from transformers import AutoModelForCausalLM   # now the TPU class
+"""
+
+from __future__ import annotations
+
+_patched_attrs: list[tuple[object, str, object]] = []
+_patched: str | None = None
+
+
+def _replace_attr(obj, name: str, value) -> None:
+    _patched_attrs.append((obj, name, getattr(obj, name)))
+    setattr(obj, name, value)
+
+
+def llm_patch(train: bool = False) -> None:
+    """Swap transformers' Auto classes for the TPU drop-ins.
+
+    ``train=True`` additionally points ``transformers`` model classes used
+    by finetune scripts at the low-bit loader (training itself runs through
+    ipex_llm_tpu.training — the reference's peft monkey-patching has no
+    torch-peft equivalent on the jax path, so scripts use
+    ipex_llm_tpu.training.qlora directly)."""
+    global _patched
+    if _patched:
+        return
+    import transformers
+
+    from ipex_llm_tpu.transformers import (
+        AutoModel,
+        AutoModelForCausalLM,
+        AutoModelForSpeechSeq2Seq,
+    )
+    from ipex_llm_tpu.transformers.multimodal import AutoModelForVision2Seq
+
+    try:
+        _replace_attr(transformers, "AutoModelForCausalLM",
+                      AutoModelForCausalLM)
+        _replace_attr(transformers, "AutoModel", AutoModel)
+        _replace_attr(transformers, "AutoModelForSpeechSeq2Seq",
+                      AutoModelForSpeechSeq2Seq)
+        _replace_attr(transformers, "AutoModelForVision2Seq",
+                      AutoModelForVision2Seq)
+        # common direct-class uses in example scripts
+        _replace_attr(transformers, "LlamaForCausalLM", AutoModelForCausalLM)
+    except Exception:
+        # roll back the partial patch so transformers is never left in a
+        # mixed state and a later llm_patch() can retry cleanly
+        for obj, name, orig in reversed(_patched_attrs):
+            setattr(obj, name, orig)
+        _patched_attrs.clear()
+        raise
+    _patched = "Train" if train else "Inference"
+
+
+def llm_unpatch() -> None:
+    """Restore the original transformers attributes."""
+    global _patched
+    if not _patched:
+        return
+    for obj, name, orig in reversed(_patched_attrs):
+        setattr(obj, name, orig)
+    _patched_attrs.clear()
+    _patched = None

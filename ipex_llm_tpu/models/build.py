@@ -24,26 +24,38 @@ from ipex_llm_tpu.quantize.core import QTensor
 NORM_DTYPE = jnp.float32
 
 
-def quantize_weight(w: np.ndarray, qtype: str) -> QTensor:
+def quantize_weight(w: np.ndarray, qtype: str,
+                    imatrix: np.ndarray | None = None) -> QTensor:
     """Quantize one HF-layout [out, in] weight to a [in, out] QTensor.
 
     ``mixed_fp4``/``mixed_fp8`` implement the reference's
     Mixture-of-Formats policy (ggml/quantize.py:36-37): try the float format
     and the int format, keep whichever reconstructs this tensor better.
+    ``imatrix`` is a per-input-channel importance vector enabling the
+    reference's weighted quantization (ggml_quantize_tensor_with_weights).
     """
     wt = np.ascontiguousarray(w.T)
     if qtype in ("mixed_fp4", "mixed_fp8"):
         fp = "fp4" if qtype == "mixed_fp4" else "fp8_e4m3"
         alt = "sym_int4" if qtype == "mixed_fp4" else "sym_int8"
+        # importance weights both the candidate codecs (where their kind
+        # supports it) and the format-pick metric itself
+        imw = (jnp.asarray(imatrix, jnp.float32)[:, None]
+               if imatrix is not None else 1.0)
         cand = []
         for q in (fp, alt):
-            qt = qcore.quantize(wt, q)
-            err = float(
-                jnp.mean((qcore.dequantize(qt) - jnp.asarray(wt)) ** 2)
-            )
+            qt = qcore.quantize(wt, q, imatrix=imatrix)
+            err = float(jnp.mean(
+                imw * (qcore.dequantize(qt) - jnp.asarray(wt)) ** 2))
             cand.append((err, qt))
         return min(cand, key=lambda c: c[0])[1]
-    return qcore.quantize(wt, qtype)
+    return qcore.quantize(wt, qtype, imatrix=imatrix)
+
+
+def _imx(imatrix_data, layer: int, slot: str, expert: int | None = None):
+    from ipex_llm_tpu.quantize.imatrix import slot_importance
+
+    return slot_importance(imatrix_data, layer, slot, expert)
 
 
 def stack_layer_trees(trees: list[dict[str, Any]]) -> dict[str, Any]:
@@ -64,6 +76,7 @@ def build_params(
     embedding_qtype: str | None = None,
     qkv_transform: Callable | None = None,
     transpose_weights: bool = False,
+    imatrix_data: dict | None = None,
 ) -> dict[str, Any]:
     """Assemble the full decoder param pytree, quantizing as it streams.
 
@@ -184,12 +197,14 @@ def build_params(
             qkv_w = np.concatenate([qw, kw, vw], axis=0)  # [out_total, in]
             qkv_b = np.concatenate(bs) if bs[0] is not None else None
         if not (scheme.kv_a is not None and cfg.is_mla):
-            lp["qkv"] = quantize_weight(qkv_w, qtype)
+            lp["qkv"] = quantize_weight(
+                qkv_w, qtype, imatrix=_imx(imatrix_data, i, "qkv"))
             if qkv_b is not None:
                 lp["qkv_bias"] = jnp.asarray(qkv_b, jnp.float32)
 
         ow = getp(name(scheme.o, i))
-        lp["o"] = quantize_weight(ow, qtype)
+        lp["o"] = quantize_weight(ow, qtype,
+                                  imatrix=_imx(imatrix_data, i, "o"))
         ob = get_opt(name(scheme.o, i, "bias"))
         if ob is not None:
             lp["o_bias"] = jnp.asarray(ob, jnp.float32)
@@ -213,8 +228,11 @@ def build_params(
                 gw = get(moe_scheme.e_gate.format(i=i, e=e))
                 uw = get(moe_scheme.e_up.format(i=i, e=e))
                 dw = get(moe_scheme.e_down.format(i=i, e=e))
-                e_gu.append(quantize_weight(np.concatenate([gw, uw], 0), qtype))
-                e_down.append(quantize_weight(dw, qtype))
+                e_gu.append(quantize_weight(
+                    np.concatenate([gw, uw], 0), qtype,
+                    imatrix=_imx(imatrix_data, i, "gate_up", e)))
+                e_down.append(quantize_weight(
+                    dw, qtype, imatrix=_imx(imatrix_data, i, "down", e)))
             lp["moe_gate_up"] = stack_layer_trees(e_gu)
             lp["moe_down"] = stack_layer_trees(e_down)
             if moe_scheme.shared_gate is not None:
@@ -235,11 +253,14 @@ def build_params(
 
         # --- non-gated mlp (phi/gpt-neox/starcoder2: fc1 -> act -> fc2)
         if scheme.gate_up is None and scheme.gate is None:
-            lp["up"] = quantize_weight(getp(name(scheme.up, i)), qtype)
+            lp["up"] = quantize_weight(getp(name(scheme.up, i)), qtype,
+                                       imatrix=_imx(imatrix_data, i, "up"))
             ub = get_opt(name(scheme.up, i, "bias"))
             if ub is not None:
                 lp["up_bias"] = jnp.asarray(ub, jnp.float32)
-            lp["down"] = quantize_weight(getp(name(scheme.down, i)), qtype)
+            lp["down"] = quantize_weight(
+                getp(name(scheme.down, i)), qtype,
+                imatrix=_imx(imatrix_data, i, "down"))
             db = get_opt(name(scheme.down, i, "bias"))
             if db is not None:
                 lp["down_bias"] = jnp.asarray(db, jnp.float32)
@@ -257,10 +278,13 @@ def build_params(
             gb = get_opt(name(scheme.gate, i, "bias"))
             ub = get_opt(name(scheme.up, i, "bias"))
             gu_b = np.concatenate([gb, ub]) if gb is not None else None
-        lp["gate_up"] = quantize_weight(gu_w, qtype)
+        lp["gate_up"] = quantize_weight(
+            gu_w, qtype, imatrix=_imx(imatrix_data, i, "gate_up"))
         if gu_b is not None:
             lp["gate_up_bias"] = jnp.asarray(gu_b, jnp.float32)
-        lp["down"] = quantize_weight(getp(name(scheme.down, i)), qtype)
+        lp["down"] = quantize_weight(
+            getp(name(scheme.down, i)), qtype,
+            imatrix=_imx(imatrix_data, i, "down"))
         db = get_opt(name(scheme.down, i, "bias"))
         if db is not None:
             lp["down_bias"] = jnp.asarray(db, jnp.float32)

@@ -1,0 +1,87 @@
+"""llama.cpp importance-matrix (imatrix) file support.
+
+Reference counterpart: ``load_imatrix_data`` (reference
+transformers/utils.py:186-240, itself adapted from llama.cpp's quantize
+tool) and the ``imatrix=`` kwarg on ``from_pretrained`` (reference
+model.py:111,333).  The binary layout is llama.cpp's public format:
+
+    int32 n_entries
+    per entry: int32 name_len, name bytes (e.g. "blk.14.attn_output.weight"),
+               int32 ncall, int32 nval, float32 values[nval]
+
+Entries are re-keyed "{layer}_{slot}" ("14_o", "0_q", "3_down", and
+"{layer}_{slot}_{expert}" for MoE) to stay checkpoint-name agnostic; the
+values are per-input-channel importance (mean squared activations), which
+``quantize/core.quantize(..., imatrix=...)`` uses for weighted scale
+search."""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: gguf tensor stem -> slot key used by the loader
+_STEM_TO_SLOT = {
+    "attn_q": "q", "attn_k": "k", "attn_v": "v", "attn_output": "o",
+    "attn_qkv": "qkv",
+    "ffn_gate": "gate", "ffn_up": "up", "ffn_down": "down",
+}
+
+
+def load_imatrix(path: str) -> dict[str, np.ndarray]:
+    """Parse a llama.cpp imatrix file -> {"{layer}_{slot}": [in_features]}."""
+    data: dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        n_entries = int.from_bytes(f.read(4), "little")
+        if n_entries < 1:
+            raise ValueError(f"no entries in imatrix file {path!r}")
+        for _ in range(n_entries):
+            name_len = int.from_bytes(f.read(4), "little")
+            name = f.read(name_len).decode("utf-8")
+            ncall = int.from_bytes(f.read(4), "little")
+            nval = int.from_bytes(f.read(4), "little")
+            if nval < 1:
+                raise ValueError(f"bad entry {name!r} in {path!r}")
+            vals = np.frombuffer(f.read(4 * nval), dtype=np.float32).copy()
+            if ncall > 0:
+                vals = vals / ncall
+            key = _rekey(name)
+            if key is not None:
+                data[key] = vals
+    return data
+
+
+def _rekey(name: str) -> str | None:
+    parts = name.split(".")
+    if parts[0] != "blk" or len(parts) < 4:
+        return None          # output.weight / token_embd etc: unused
+    layer = parts[1]
+    stem = parts[2]
+    slot = _STEM_TO_SLOT.get(stem)
+    if slot is None:
+        return None
+    if len(parts) == 5:      # mixtral per-expert: blk.0.ffn_down.3.weight
+        return f"{layer}_{slot}_{parts[3]}"
+    return f"{layer}_{slot}"
+
+
+def slot_importance(data: dict[str, np.ndarray] | None, layer: int,
+                    slot: str, expert: int | None = None
+                    ) -> np.ndarray | None:
+    """Importance vector for one (layer, slot[, expert]) with
+    merged-projection fallbacks: the fused qkv matmul reads the attention
+    input (same activations llama.cpp records for attn_q), and the fused
+    gate_up matmul reads the MLP input (recorded for ffn_gate/ffn_up).
+    ``expert`` selects mixtral-style per-expert entries
+    ("blk.N.ffn_down.E.weight"), falling back to the shared entry."""
+    if data is None:
+        return None
+    cands = {
+        "qkv": [f"{layer}_qkv", f"{layer}_q", f"{layer}_k", f"{layer}_v"],
+        "gate_up": [f"{layer}_gate", f"{layer}_up"],
+    }.get(slot, [f"{layer}_{slot}"])
+    if expert is not None:
+        cands = [f"{c}_{expert}" for c in cands] + cands
+    for c in cands:
+        if c in data:
+            return data[c]
+    return None

@@ -288,7 +288,19 @@ class OpenAIServer:
         })
 
     async def health(self, request):
-        return web.json_response({"status": "ok"})
+        """Liveness that actually reflects the engine (failure-detection
+        surface, SURVEY §5): dead engine thread -> 503; recent step errors
+        surface as degraded."""
+        thread = self.engine._thread
+        if thread is None or not thread.is_alive():
+            return web.json_response(
+                {"status": "dead", "error": "engine thread not running"},
+                status=503)
+        body = {"status": "ok"}
+        last = self.engine.metrics.get("last_error")
+        if last:
+            body = {"status": "degraded", "last_error": str(last)}
+        return web.json_response(body)
 
     async def metrics(self, request):
         return web.json_response(dict(self.engine.metrics))

@@ -460,6 +460,10 @@ class ServingEngine:
         while not self._stop.is_set():
             try:
                 self._step_once()
+                # a completed step means the engine recovered: clear the
+                # sticky error so /health goes back to "ok"
+                if self.metrics.get("last_error"):
+                    self.metrics["last_error"] = ""
             except Exception as exc:  # keep the serving thread alive
                 self._fail_all(exc)
 

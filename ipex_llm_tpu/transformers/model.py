@@ -85,6 +85,7 @@ class TPUModelForCausalLM:
             )
         qtype = _resolve_qtype(kwargs)
         mixed_precision = kwargs.pop("mixed_precision", False)
+        imatrix_file = kwargs.pop("imatrix", None)
         mesh = kwargs.pop("mesh", None)
         speculative = kwargs.pop("speculative", False)
         embedding_qtype = kwargs.pop("embedding_qtype", None)
@@ -130,12 +131,20 @@ class TPUModelForCausalLM:
             reader = QuantizedCheckpointAdapter(reader, qc)
             if qtype == "bf16":  # keep a 4-bit checkpoint 4-bit by default
                 qtype = "asym_int4"
+        imatrix_data = None
+        if imatrix_file is not None:
+            # reference model.py:333: imatrix file from llama.cpp's tool
+            from ipex_llm_tpu.quantize.imatrix import load_imatrix
+
+            imatrix_data = (imatrix_file if isinstance(imatrix_file, dict)
+                            else load_imatrix(imatrix_file))
         params = build_params(
             cfg, family.scheme, reader.get, reader.has,
             qtype=qtype, mixed_precision=mixed_precision,
             moe_scheme=family.moe, embedding_qtype=embedding_qtype,
             qkv_transform=family.qkv_transform,
             transpose_weights=family.transpose_weights,
+            imatrix_data=imatrix_data,
         )
         model = cls(cfg, params, hf_config, qtype)
         if speculative:

@@ -499,3 +499,35 @@ def test_tgi_stop_sequence_reason(tiny_ckpt):
         assert asyncio.run(main())
     finally:
         srv.engine.stop()
+
+
+def test_health_reflects_engine_state(tiny_ckpt):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ipex_llm_tpu.serving.api_server import build_server
+    from ipex_llm_tpu.serving.engine import EngineConfig
+
+    srv = build_server(tiny_ckpt, low_bit="sym_int4",
+                       engine_config=EngineConfig(max_rows=2,
+                                                  max_seq_len=128))
+
+    async def run():
+        async with TestClient(TestServer(srv.app)) as client:
+            r = await client.get("/health")
+            assert r.status == 200
+            assert (await r.json())["status"] == "ok"
+
+            srv.engine.metrics["last_error"] = "RuntimeError: boom"
+            r = await client.get("/health")
+            assert (await r.json())["status"] == "degraded"
+            srv.engine.metrics["last_error"] = ""
+
+            srv.engine.stop()
+            srv.engine._thread.join(timeout=10)
+            r = await client.get("/health")
+            assert r.status == 503
+            return True
+
+    assert asyncio.run(run())

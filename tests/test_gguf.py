@@ -286,3 +286,147 @@ def test_from_gguf_model_api(tmp_path, tiny_hf):
     model, _tok = AutoModelForCausalLM.from_gguf(p)
     out = model.generate(np.arange(4, 16, dtype=np.int32), max_new_tokens=6)
     assert out.shape[1] == 12 + 6
+
+
+# ---------------------------------------------------------------------------
+# fused-qkv architectures: bloom / falcon / mpt (reference gguf/models/
+# {bloom,falcon,mpt}.py).  llama.cpp converters store attn_qkv as the
+# standard [q; k; v] concat, which these synthetic files replicate.
+# ---------------------------------------------------------------------------
+
+
+def _run_gguf(p, tokens):
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.gguf import load_gguf_model
+    from ipex_llm_tpu.kv import KVCache
+    from ipex_llm_tpu.models.decoder import decoder_forward
+
+    cfg, params, _ = load_gguf_model(p)
+    cache = KVCache.init(cfg.num_layers, 1, tokens.shape[1],
+                         cfg.num_kv_heads, cfg.head_dim)
+    pos = jnp.arange(tokens.shape[1])[None, :]
+    got, _ = decoder_forward(cfg, params, jnp.asarray(tokens), cache, pos)
+    return np.asarray(got)
+
+
+def test_from_gguf_bloom(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import BloomConfig, BloomForCausalLM
+
+    from ipex_llm_tpu.models.config import ModelConfig
+    from ipex_llm_tpu.models.families import _neox_qkv, get_family
+
+    cfg = BloomConfig(vocab_size=160, hidden_size=64, n_layer=2, n_head=4,
+                      layer_norm_epsilon=1e-5)
+    torch.manual_seed(0)
+    hf = BloomForCausalLM(cfg).eval()
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+    mc = get_family("bloom").to_config(
+        {"model_type": "bloom", "vocab_size": 160, "hidden_size": 64,
+         "n_layer": 2, "n_head": 4, "layer_norm_epsilon": 1e-5})
+
+    meta = {
+        "general.architecture": "bloom",
+        "bloom.block_count": 2, "bloom.embedding_length": 64,
+        "bloom.feed_forward_length": 256,
+        "bloom.attention.head_count": 4,
+        "bloom.attention.layer_norm_epsilon": 1e-5,
+    }
+    t = {
+        "token_embd.weight": (sd["transformer.word_embeddings.weight"], "f16"),
+        "token_embd_norm.weight": (
+            sd["transformer.word_embeddings_layernorm.weight"], "f32"),
+        "token_embd_norm.bias": (
+            sd["transformer.word_embeddings_layernorm.bias"], "f32"),
+        "output_norm.weight": (sd["transformer.ln_f.weight"], "f32"),
+        "output_norm.bias": (sd["transformer.ln_f.bias"], "f32"),
+    }
+    for i in range(2):
+        b = f"transformer.h.{i}."
+        t[f"blk.{i}.attn_norm.weight"] = (sd[b + "input_layernorm.weight"], "f32")
+        t[f"blk.{i}.attn_norm.bias"] = (sd[b + "input_layernorm.bias"], "f32")
+        t[f"blk.{i}.ffn_norm.weight"] = (
+            sd[b + "post_attention_layernorm.weight"], "f32")
+        t[f"blk.{i}.ffn_norm.bias"] = (
+            sd[b + "post_attention_layernorm.bias"], "f32")
+        # deinterleave HF's per-head [q;k;v] fusion into standard concat
+        t[f"blk.{i}.attn_qkv.weight"] = (
+            _neox_qkv(sd[b + "self_attention.query_key_value.weight"], mc),
+            "q8_0")
+        t[f"blk.{i}.attn_qkv.bias"] = (
+            _neox_qkv(sd[b + "self_attention.query_key_value.bias"][:, None],
+                      mc)[:, 0], "f32")
+        t[f"blk.{i}.attn_output.weight"] = (
+            sd[b + "self_attention.dense.weight"], "q8_0")
+        t[f"blk.{i}.attn_output.bias"] = (
+            sd[b + "self_attention.dense.bias"], "f32")
+        t[f"blk.{i}.ffn_up.weight"] = (sd[b + "mlp.dense_h_to_4h.weight"], "q8_0")
+        t[f"blk.{i}.ffn_up.bias"] = (sd[b + "mlp.dense_h_to_4h.bias"], "f32")
+        t[f"blk.{i}.ffn_down.weight"] = (sd[b + "mlp.dense_4h_to_h.weight"], "q8_0")
+        t[f"blk.{i}.ffn_down.bias"] = (sd[b + "mlp.dense_4h_to_h.bias"], "f32")
+    p = str(tmp_path / "bloom.gguf")
+    write_gguf(p, meta, t)
+
+    tokens = np.random.default_rng(1).integers(0, 160, (1, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens).long()).logits.float().numpy()
+    got = _run_gguf(p, tokens)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+
+def test_from_gguf_falcon(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import FalconConfig, FalconForCausalLM
+
+    from ipex_llm_tpu.models.families import _falcon_qkv, get_family
+
+    cfg = FalconConfig(vocab_size=160, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_kv_heads=2,
+                       new_decoder_architecture=True, bias=False,
+                       parallel_attn=True, alibi=False)
+    torch.manual_seed(1)
+    hf = FalconForCausalLM(cfg).eval()
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+    mc = get_family("falcon").to_config(
+        {"model_type": "falcon", "vocab_size": 160, "hidden_size": 64,
+         "num_hidden_layers": 2, "num_attention_heads": 4, "num_kv_heads": 2,
+         "new_decoder_architecture": True, "bias": False,
+         "parallel_attn": True, "alibi": False})
+
+    meta = {
+        "general.architecture": "falcon",
+        "falcon.block_count": 2, "falcon.embedding_length": 64,
+        "falcon.feed_forward_length": 256,
+        "falcon.attention.head_count": 4,
+        "falcon.attention.head_count_kv": 2,
+        "falcon.attention.layer_norm_epsilon": 1e-5,
+        "falcon.rope.freq_base": 10000.0,
+    }
+    t = {
+        "token_embd.weight": (sd["transformer.word_embeddings.weight"], "f16"),
+        "output_norm.weight": (sd["transformer.ln_f.weight"], "f32"),
+        "output_norm.bias": (sd["transformer.ln_f.bias"], "f32"),
+    }
+    for i in range(2):
+        b = f"transformer.h.{i}."
+        t[f"blk.{i}.attn_norm.weight"] = (sd[b + "ln_attn.weight"], "f32")
+        t[f"blk.{i}.attn_norm.bias"] = (sd[b + "ln_attn.bias"], "f32")
+        t[f"blk.{i}.attn_norm_2.weight"] = (sd[b + "ln_mlp.weight"], "f32")
+        t[f"blk.{i}.attn_norm_2.bias"] = (sd[b + "ln_mlp.bias"], "f32")
+        t[f"blk.{i}.attn_qkv.weight"] = (
+            _falcon_qkv(sd[b + "self_attention.query_key_value.weight"], mc),
+            "q8_0")
+        t[f"blk.{i}.attn_output.weight"] = (
+            sd[b + "self_attention.dense.weight"], "q8_0")
+        t[f"blk.{i}.ffn_up.weight"] = (sd[b + "mlp.dense_h_to_4h.weight"], "q8_0")
+        t[f"blk.{i}.ffn_down.weight"] = (sd[b + "mlp.dense_4h_to_h.weight"], "q8_0")
+    p = str(tmp_path / "falcon.gguf")
+    write_gguf(p, meta, t)
+
+    tokens = np.random.default_rng(2).integers(0, 160, (1, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens).long()).logits.float().numpy()
+    got = _run_gguf(p, tokens)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06

@@ -200,3 +200,62 @@ def test_int5_is_actually_packed():
         back = np.asarray(dequantize(qt))
         err = np.abs(back - w).mean() / np.abs(w).mean()
         assert err < 0.05, (name, err)
+
+
+def test_imatrix_file_roundtrip_and_from_pretrained(tmp_path):
+    """llama.cpp imatrix binary parse + weighted quantization through the
+    from_pretrained kwarg (reference model.py:111,333 + utils.py:186)."""
+    import struct
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from ipex_llm_tpu.quantize.imatrix import load_imatrix, slot_importance
+
+    # write an imatrix file covering layer 0's projections
+    entries = {
+        "blk.0.attn_q.weight": np.random.default_rng(0).uniform(
+            0.5, 2.0, 64).astype(np.float32),
+        "blk.0.attn_output.weight": np.random.default_rng(1).uniform(
+            0.5, 2.0, 64).astype(np.float32),
+        "blk.0.ffn_down.weight": np.random.default_rng(2).uniform(
+            0.5, 2.0, 96).astype(np.float32),
+        "blk.0.ffn_gate.weight": np.random.default_rng(3).uniform(
+            0.5, 2.0, 64).astype(np.float32),
+        "output.weight": np.ones(64, np.float32),      # ignored (not blk)
+    }
+    p = tmp_path / "test.imatrix"
+    with open(p, "wb") as f:
+        f.write(struct.pack("<i", len(entries)))
+        for name, vals in entries.items():
+            nb = name.encode()
+            f.write(struct.pack("<i", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<ii", 2, len(vals)))   # ncall=2
+            f.write((vals * 2).astype(np.float32).tobytes())
+
+    data = load_imatrix(str(p))
+    assert np.allclose(data["0_q"], entries["blk.0.attn_q.weight"])
+    assert np.allclose(data["0_down"], entries["blk.0.ffn_down.weight"])
+    # merged-projection fallbacks
+    assert slot_importance(data, 0, "qkv") is not None
+    assert slot_importance(data, 0, "gate_up") is not None
+    assert slot_importance(data, 1, "qkv") is None
+
+    # end-to-end: quantize-with-imatrix must load and stay close to HF
+    cfg = LlamaConfig(vocab_size=160, hidden_size=64, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(cfg).eval()
+    mpath = str(tmp_path / "m")
+    hf.save_pretrained(mpath, safe_serialization=True)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(
+        mpath, load_in_low_bit="sym_int4", imatrix=str(p))
+    toks = np.random.default_rng(4).integers(0, 160, (1, 8)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks).long()).logits.float().numpy()
+    got = np.asarray(m(toks))
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.35  # int4 tol

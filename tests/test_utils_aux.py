@@ -47,3 +47,22 @@ def test_init_distributed_single_host(monkeypatch):
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
     monkeypatch.delenv("IPEX_LLM_TPU_NUM_PROCESSES", raising=False)
     assert bootstrap.init_distributed() is False
+
+
+def test_llm_patch_swaps_auto_classes():
+    """One-line patching (reference llm_patching.py:35-88)."""
+    import transformers
+
+    from ipex_llm_tpu import llm_patch, llm_unpatch
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM as TPUAuto
+
+    orig = transformers.AutoModelForCausalLM
+    llm_patch()
+    try:
+        assert transformers.AutoModelForCausalLM is TPUAuto
+        assert transformers.LlamaForCausalLM is TPUAuto
+        llm_patch()  # idempotent
+        assert transformers.AutoModelForCausalLM is TPUAuto
+    finally:
+        llm_unpatch()
+    assert transformers.AutoModelForCausalLM is orig
