@@ -1,0 +1,99 @@
+"""MiniCPM-V: SigLIP tower + perceiver resampler + minicpm/qwen2 text.
+
+Reference counterpart: transformers/models/minicpmv.py — the reference
+patches the remote-code model's SigLIP attention (:44), the vision
+transformer (:176), and wraps chat/generate; the resampler semantics are
+the public MiniCPM-V-2.6 design: 64 learned queries cross-attend the patch
+features, with a 2D-sincos position term added to the KEYS only
+(v2.6 ``Resampler.forward``: ``attn(q, x + pos_embed, x)``), then
+``ln_post`` and an output projection matrix.
+
+The tower reuses models/vision_clip.py's "siglip" variant (HF
+``SiglipVisionModel`` weight names under the ``vpm.`` prefix — mainline
+code doubles as the tower's parity oracle).  Image features enter the text
+stream at ``image_bound`` spans, the same (start, end) index pairs the
+remote model's own forward consumes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+def sincos_2d(embed_dim: int, gh: int, gw: int) -> np.ndarray:
+    """MAE-style 2D sin-cos table [gh*gw, embed_dim].
+
+    Channel order follows the upstream ``get_2d_sincos_pos_embed`` exactly:
+    ``np.meshgrid(grid_w, grid_h)`` puts the COLUMN coordinate in grid[0],
+    so the first half of the channels encodes the column index and the
+    second half the row — trained resampler weights depend on this order."""
+    def one_d(d, pos):
+        omega = 1.0 / (10000.0 ** (np.arange(d // 2, dtype=np.float64)
+                                   / (d // 2)))
+        out = np.einsum("m,d->md", pos.reshape(-1), omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    gy, gx = np.meshgrid(np.arange(gh, dtype=np.float64),
+                         np.arange(gw, dtype=np.float64), indexing="ij")
+    emb = np.concatenate(
+        [one_d(embed_dim // 2, gx), one_d(embed_dim // 2, gy)], axis=1)
+    return emb.astype(np.float32)
+
+
+def build_resampler_params(get, has, qtype: str, prefix: str = "resampler."
+                           ) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight
+
+    def f32(n):
+        return jnp.asarray(get(prefix + n), jnp.float32)
+
+    def ln(name):
+        return {"w": f32(name + ".weight"), "b": f32(name + ".bias")}
+
+    r: dict[str, Any] = {
+        "query": f32("query"),                          # [nq, E]
+        "kv_proj": quantize_weight(get(prefix + "kv_proj.weight"), qtype),
+        "ln_q": ln("ln_q"), "ln_kv": ln("ln_kv"), "ln_post": ln("ln_post"),
+        "proj": quantize_weight(
+            np.ascontiguousarray(get(prefix + "proj").T), qtype),
+        "in_proj": quantize_weight(get(prefix + "attn.in_proj_weight"),
+                                   qtype),
+        "in_proj_b": f32("attn.in_proj_bias"),
+        "o": quantize_weight(get(prefix + "attn.out_proj.weight"), qtype),
+        "o_b": f32("attn.out_proj.bias"),
+    }
+    return r
+
+
+@partial(jax.jit, static_argnames=("n_heads", "grid"))
+def resampler_forward(r: dict, feats: jnp.ndarray, n_heads: int,
+                      grid: tuple[int, int]) -> jnp.ndarray:
+    """feats [B, L, vision_dim] -> [B, nq, E] image tokens (v2.6 order:
+    k = ln_kv(kv_proj(x)) + sincos(grid), v without the position term)."""
+    b, l, _ = feats.shape
+    e = r["query"].shape[1]
+    kv = linear_ops.linear(feats.astype(jnp.bfloat16), r["kv_proj"]
+                           ).astype(jnp.float32)
+    kv = layer_norm(kv, r["ln_kv"]["w"], r["ln_kv"]["b"], 1e-6)
+    pos = jnp.asarray(sincos_2d(e, grid[0], grid[1]))
+    k = kv + pos[None]
+    q = layer_norm(r["query"], r["ln_q"]["w"], r["ln_q"]["b"], 1e-6)
+    q = q[None].repeat(b, axis=0)
+    nq = q.shape[1]
+
+    from ipex_llm_tpu.ops.attention import packed_mha
+
+    out = packed_mha(q, k, kv, r["in_proj"], r["in_proj_b"], r["o"],
+                     r["o_b"], n_heads)
+    out = layer_norm(out, r["ln_post"]["w"], r["ln_post"]["b"], 1e-6)
+    return linear_ops.linear(out.astype(jnp.bfloat16), r["proj"]
+                             ).astype(jnp.float32)

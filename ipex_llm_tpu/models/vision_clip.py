@@ -49,8 +49,12 @@ class ClipVisionConfig:
     # "janus" (SigLIP-style): no CLS, no pre-LN, post-layernorm applied,
     # aligner projector fc1 + (depth-1) hidden layers (reference janus.py
     # attention patch; HF JanusVisionModel/JanusVisionAlignerMLP).
+    # "siglip" (MiniCPM-V's vpm): janus block layout with HF Siglip names
+    # (out_proj) and NO projector — raw post-norm patch features out
+    # (reference minicpmv.py:44 siglip_attention_forward patch target).
     variant: str = "clip"
     aligner_depth: int = 2
+    prefix: str = ""            # checkpoint prefix override (e.g. "vpm.")
 
     @property
     def head_dim(self) -> int:
@@ -87,7 +91,10 @@ def build_clip_vision_params(vc: ClipVisionConfig, get, has,
                              qtype: str) -> dict:
     from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
 
-    if vc.variant == "janus":
+    if vc.variant == "siglip":
+        vt, mp = vc.prefix or "vpm.", None
+        o_name = "self_attn.out_proj"
+    elif vc.variant == "janus":
         vt, mp = "model.vision_model.", "model.aligner."
         if not has(vt + "embeddings.patch_embedding.weight"):
             vt, mp = "vision_model.", "aligner."
@@ -146,6 +153,8 @@ def build_clip_vision_params(vc: ClipVisionConfig, get, has,
         layers.append(lp)
     p["blocks"] = stack_layer_trees(layers)
 
+    if vc.variant == "siglip":
+        return p            # raw features out; resampler lives elsewhere
     if vc.variant == "janus":
         p["proj_fc1"] = quantize_weight(get(mp + "fc1.weight"), qtype)
         p["proj_fc1_b"] = jnp.asarray(get(mp + "fc1.bias"), jnp.float32)
@@ -181,7 +190,15 @@ def clip_vision_forward(vc: ClipVisionConfig, params: dict,
         cls = jnp.broadcast_to(params["cls_token"][None],
                                (b, 1, vc.hidden_size))
         x = jnp.concatenate([cls, x], axis=1)
-    x = x + params["pos"][None, : x.shape[1]]
+        x = x + params["pos"][None, : x.shape[1]]
+    elif params["pos"].shape[0] != x.shape[1]:
+        # variable-resolution siglip (MiniCPM-V slices): bicubic-resample
+        # the position table to this grid instead of silently truncating
+        from ipex_llm_tpu.models.vision_qwenvl import _interp_pos
+
+        x = x + _interp_pos(params["pos"], x.shape[1])[None]
+    else:
+        x = x + params["pos"][None]
     if "pre_ln" in params:
         x = layer_norm(x, params["pre_ln"], params.get("pre_ln_b"),
                        vc.norm_eps)
@@ -222,6 +239,8 @@ def clip_vision_forward(vc: ClipVisionConfig, params: dict,
                        vc.norm_eps)
 
     feats = x[:, 1:] if vc.select_strategy == "default" else x
+    if vc.variant == "siglip":
+        return feats
     if vc.variant == "janus":
         # aligner (JanusVisionAlignerMLP): h = fc1(x); per extra depth step
         # h = hidden_i(act(h)) — activation BETWEEN layers, none at the end

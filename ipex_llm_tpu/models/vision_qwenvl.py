@@ -1,0 +1,174 @@
+"""Qwen-VL (v1) visual tower: OpenCLIP-style ViT + cross-attention resampler.
+
+Reference counterpart: transformers/models/qwen_vl.py —
+``qwen_vl_vision_transformer_forward`` (:226, conv patches + interpolated
+absolute positions + ln_pre + resblocks + attn_pool + ln_post + @proj) and
+``qwen_vl_resampler_forward`` (:209, learned queries cross-attending the
+patch sequence with 2D-sincos position terms on both sides).
+
+TPU-first shape choices mirror the other towers: the stride==kernel conv is
+a matmul, the resblocks run as one ``lax.scan``, packed ``in_proj`` MHA
+weights quantize as single GEMMs, and the bicubic position interpolation
+(reference get_abs_pos :53) is ``jax.image.resize`` — half-pixel bicubic,
+the same kernel family as torch's ``align_corners=False``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.attention import sdpa_reference
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class QwenVLVisionConfig:
+    width: int                  # ViT hidden
+    num_layers: int
+    num_heads: int
+    mlp_ratio: float
+    patch_size: int
+    image_size: int
+    output_dim: int             # resampler/LLM-facing dim
+    n_queries: int = 256
+    resampler_heads: int = 32   # Resampler: output_dim // 128
+    norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.num_heads
+
+    @classmethod
+    def from_hf(cls, v: dict) -> "QwenVLVisionConfig":
+        out = v["output_dim"]
+        return cls(
+            width=v["width"], num_layers=v["layers"], num_heads=v["heads"],
+            mlp_ratio=v.get("mlp_ratio", 4.9231),
+            patch_size=v.get("patch_size", 14),
+            image_size=v.get("image_size", 448),
+            output_dim=out,
+            n_queries=v.get("n_queries", 256),
+            resampler_heads=v.get("resampler_heads", max(1, out // 128)),
+        )
+
+
+def build_qwenvl_vision_params(vc: QwenVLVisionConfig, get, has,
+                               qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    vt = "transformer.visual."
+    if not has(vt + "conv1.weight"):
+        raise ValueError("no Qwen-VL visual weights found in checkpoint")
+
+    def f32(n):
+        return jnp.asarray(get(n), jnp.float32)
+
+    def ln(name):
+        return {"w": f32(name + ".weight"), "b": f32(name + ".bias")}
+
+    cw = get(vt + "conv1.weight")            # [W, 3, ps, ps], no bias
+    p: dict[str, Any] = {
+        "patch_proj": quantize_weight(
+            np.ascontiguousarray(cw.reshape(cw.shape[0], -1)), qtype),
+        "pos": f32(vt + "positional_embedding"),
+        "ln_pre": ln(vt + "ln_pre"),
+        "ln_post": ln(vt + "ln_post"),
+        "proj": quantize_weight(
+            np.ascontiguousarray(get(vt + "proj").T), qtype),
+    }
+    blocks = []
+    for i in range(vc.num_layers):
+        b = f"{vt}transformer.resblocks.{i}."
+        blocks.append({
+            "ln1": ln(b + "ln_1"), "ln2": ln(b + "ln_2"),
+            "in_proj": quantize_weight(get(b + "attn.in_proj_weight"), qtype),
+            "in_proj_b": f32(b + "attn.in_proj_bias"),
+            "o": quantize_weight(get(b + "attn.out_proj.weight"), qtype),
+            "o_b": f32(b + "attn.out_proj.bias"),
+            "fc1": quantize_weight(get(b + "mlp.c_fc.weight"), qtype),
+            "fc1_b": f32(b + "mlp.c_fc.bias"),
+            "fc2": quantize_weight(get(b + "mlp.c_proj.weight"), qtype),
+            "fc2_b": f32(b + "mlp.c_proj.bias"),
+        })
+    p["blocks"] = stack_layer_trees(blocks)
+
+    a = vt + "attn_pool."
+    p["resampler"] = {
+        "query": f32(a + "query"),                      # [nq, E]
+        "pos_embed": f32(a + "pos_embed"),              # [nq, E] 2D sincos
+        "kv_proj": quantize_weight(get(a + "kv_proj.weight"), qtype),
+        "ln_q": ln(a + "ln_q"), "ln_kv": ln(a + "ln_kv"),
+        "in_proj": quantize_weight(get(a + "attn.in_proj_weight"), qtype),
+        "in_proj_b": f32(a + "attn.in_proj_bias"),
+        "o": quantize_weight(get(a + "attn.out_proj.weight"), qtype),
+        "o_b": f32(a + "attn.out_proj.bias"),
+    }
+    return p
+
+
+def _interp_pos(pos: jnp.ndarray, tgt: int) -> jnp.ndarray:
+    """get_abs_pos (reference qwen_vl.py:53): bicubic-resample a square
+    [L, C] position table to [tgt, C]."""
+    src = int(np.sqrt(pos.shape[0]))
+    dst = int(np.sqrt(tgt))
+    if src == dst:
+        return pos
+    grid = pos.reshape(src, src, -1)
+    out = jax.image.resize(grid, (dst, dst, grid.shape[-1]), method="bicubic")
+    return out.reshape(dst * dst, -1)
+
+
+def _mha(x_q, x_k, x_v, lp, n_heads: int):
+    from ipex_llm_tpu.ops.attention import packed_mha
+
+    return packed_mha(x_q, x_k, x_v, lp["in_proj"], lp["in_proj_b"],
+                      lp["o"], lp["o_b"], n_heads)
+
+
+@partial(jax.jit, static_argnames=("vc",))
+def qwenvl_vision_forward(vc: QwenVLVisionConfig, p: dict,
+                          pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels [B, 3, H, W] -> image tokens [B, n_queries, output_dim]."""
+    b, c, hh, ww = pixels.shape
+    ps = vc.patch_size
+    gh, gw = hh // ps, ww // ps
+    n = gh * gw
+    patches = pixels.reshape(b, c, gh, ps, gw, ps).transpose(0, 2, 4, 1, 3, 5)
+    patches = patches.reshape(b, n, c * ps * ps).astype(jnp.bfloat16)
+    x = linear_ops.linear(patches, p["patch_proj"]).astype(jnp.float32)
+    x = x + _interp_pos(p["pos"], n)[None]
+    x = layer_norm(x, p["ln_pre"]["w"], p["ln_pre"]["b"], vc.norm_eps)
+
+    def block(x, lp):
+        h = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"], vc.norm_eps)
+        x = x + _mha(h, h, h, lp, vc.num_heads)
+        h2 = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"], vc.norm_eps)
+        inner = mlp_ops.act(
+            linear_ops.linear(h2.astype(jnp.bfloat16), lp["fc1"],
+                              lp["fc1_b"]), "gelu")
+        x = x + linear_ops.linear(inner, lp["fc2"], lp["fc2_b"]
+                                  ).astype(jnp.float32)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, p["blocks"])
+
+    # resampler (attn_pool): learned queries cross-attend the patches
+    r = p["resampler"]
+    kv = linear_ops.linear(x.astype(jnp.bfloat16), r["kv_proj"]
+                           ).astype(jnp.float32)
+    kv = layer_norm(kv, r["ln_kv"]["w"], r["ln_kv"]["b"], vc.norm_eps)
+    q = layer_norm(r["query"], r["ln_q"]["w"], r["ln_q"]["b"], vc.norm_eps)
+    q = (q + r["pos_embed"])[None].repeat(b, axis=0)
+    k = kv + _interp_pos(r["pos_embed"], n)[None]
+    out = _mha(q, k, kv, r, vc.resampler_heads)
+    out = layer_norm(out, p["ln_post"]["w"], p["ln_post"]["b"], vc.norm_eps)
+    return linear_ops.linear(out.astype(jnp.bfloat16), p["proj"]
+                             ).astype(jnp.float32)

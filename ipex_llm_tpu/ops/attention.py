@@ -233,3 +233,36 @@ def cached_sdpa(
     kd = cache.decode_layer(kl, compute_dtype).transpose(0, 2, 1, 3)
     vd = cache.decode_layer(vl, compute_dtype).transpose(0, 2, 1, 3)
     return sdpa(q, kd, vd, **kwargs)
+
+
+def packed_mha(x_q, x_k, x_v, in_proj, in_proj_b, o, o_b, n_heads: int):
+    """torch ``nn.MultiheadAttention`` semantics over a packed [3E, E]
+    ``in_proj`` weight (quantized), shared by the Qwen-VL and MiniCPM-V
+    towers.  When q/k/v come from the SAME tensor (ViT self-attention) the
+    projection runs as ONE GEMM and splits; the cross-attention form pays
+    the packed width per distinct input.
+    """
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.ops import linear as linear_ops
+
+    b, nq, e = x_q.shape
+    if x_q is x_k and x_k is x_v:
+        qkv = linear_ops.linear(x_q.astype(jnp.bfloat16), in_proj, in_proj_b)
+        q, k, v = qkv[..., :e], qkv[..., e:2 * e], qkv[..., 2 * e:]
+    else:
+        q = linear_ops.linear(x_q.astype(jnp.bfloat16), in_proj,
+                              in_proj_b)[..., :e]
+        k = linear_ops.linear(x_k.astype(jnp.bfloat16), in_proj,
+                              in_proj_b)[..., e:2 * e]
+        v = linear_ops.linear(x_v.astype(jnp.bfloat16), in_proj,
+                              in_proj_b)[..., 2 * e:]
+    hd = e // n_heads
+    attn = sdpa_reference(
+        q.reshape(b, nq, n_heads, hd),
+        k.reshape(b, k.shape[1], n_heads, hd),
+        v.reshape(b, v.shape[1], n_heads, hd),
+        causal=False,
+    ).reshape(b, nq, e)
+    return linear_ops.linear(attn.astype(jnp.bfloat16), o, o_b
+                             ).astype(jnp.float32)

@@ -63,9 +63,11 @@ class _AliasReader:
         if name.startswith("model."):
             suffix = name[len("model."):]
             cands += ["model.language_model." + suffix,   # 4.52+ nested
-                      "language_model.model." + suffix]   # legacy submodel
+                      "language_model.model." + suffix,   # legacy submodel
+                      "llm.model." + suffix]              # minicpm-v
         if name == "lm_head.weight":
-            cands += ["model.lm_head.weight", "language_model.lm_head.weight"]
+            cands += ["model.lm_head.weight", "language_model.lm_head.weight",
+                      "llm.lm_head.weight"]
         for alt in cands:
             if self.reader.has(alt):
                 return alt
@@ -354,12 +356,14 @@ class TPUInternVLForConditionalGeneration:
             x = x.at[0, jnp.asarray(idx)].set(img)
         return x
 
-    def forward_logits(self, input_ids, pixel_values=None):
+    def forward_logits(self, input_ids, pixel_values=None, image_bound=None,
+                       **kwargs):
         from ipex_llm_tpu import kv as kv_mod
         from ipex_llm_tpu.models.decoder import decoder_forward
 
+        mm = {} if image_bound is None else {"image_bound": image_bound}
         ids = np.asarray(input_ids, np.int32).reshape(-1)
-        x = self._embed_multimodal(ids, pixel_values)
+        x = self._embed_multimodal(ids, pixel_values, **mm)
         cache = kv_mod.make_cache(
             "normal", self.config.num_layers, 1, len(ids),
             self.config.num_kv_heads, self.config.head_dim,
@@ -373,10 +377,11 @@ class TPUInternVLForConditionalGeneration:
         return logits
 
     def generate(self, input_ids, pixel_values=None, max_new_tokens: int = 32,
-                 **kwargs):
+                 image_bound=None, **kwargs):
+        mm = {} if image_bound is None else {"image_bound": image_bound}
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         n_p = len(ids)
-        x = self._embed_multimodal(ids, pixel_values)
+        x = self._embed_multimodal(ids, pixel_values, **mm)
         return _greedy_generate(
             self, ids, x, jnp.arange(n_p)[None, :],
             lambda step: jnp.asarray([[n_p + step]], jnp.int32),
@@ -556,13 +561,190 @@ class TPUJanusForConditionalGeneration(TPULlavaForConditionalGeneration):
         return m
 
 
-class AutoModelForVision2Seq:
-    """Vision-language loader dispatching by model_type (qwen2_vl,
-    internvl, llava, mllama, janus)."""
+class TPUQwenVLForConditionalGeneration(TPUInternVLForConditionalGeneration):
+    """Qwen-VL (v1): OpenCLIP-style ViT + cross-attn resampler feeding 256
+    image tokens per image into the qwen(v1) text model.
+
+    Reference counterpart: transformers/models/qwen_vl.py (vision
+    transformer + resampler + model forward that splices image embeds
+    between the ``image_start_id`` / ``image_start_id+1`` markers)."""
 
     @classmethod
     def from_pretrained(cls, path: str, **kwargs):
-        mt = read_config(str(path)).get("model_type")
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_qwenvl import (
+            QwenVLVisionConfig,
+            build_qwenvl_vision_params,
+        )
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        fam = get_family("qwen")
+        cfg = fam.to_config(hf_config)
+        vcfg = QwenVLVisionConfig.from_hf(hf_config["visual"])
+        reader = CheckpointReader(path)
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_qwenvl_vision_params(vcfg, reader.get, reader.has,
+                                             qtype)
+        m = cls(cfg, vcfg, params, vparams, hf_config, qtype)
+        m.image_start_id = hf_config["visual"].get("image_start_id", 151857)
+        return m
+
+    def _embed_multimodal(self, ids: np.ndarray, pixel_values):
+        from ipex_llm_tpu.models.vision_qwenvl import qwenvl_vision_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
+        if pixel_values is not None:
+            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            if px.ndim == 3:
+                px = px[None]
+            img = qwenvl_vision_forward(self.vision_config,
+                                        self.vision_params, px)
+            # splice each image's n_queries tokens between its start/end
+            # markers (reference qwen_vl.py model forward: bos_pos /
+            # eos_pos pairs)
+            ids_np = np.asarray(ids)
+            (starts,) = np.nonzero(ids_np == self.image_start_id)
+            (ends,) = np.nonzero(ids_np == self.image_start_id + 1)
+            nq = self.vision_config.n_queries
+            assert len(starts) == len(ends) == img.shape[0], (
+                f"{len(starts)} image markers vs {img.shape[0]} images")
+            for j, (s, e) in enumerate(zip(starts, ends)):
+                assert e - s - 1 == nq, (
+                    f"{e - s - 1} slots between markers != {nq} queries")
+                x = x.at[0, s + 1 : e].set(img[j].astype(x.dtype))
+        return x
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_qwenvl import QwenVLVisionConfig
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        cfg = get_family("qwen").to_config(hf)
+        vcfg = QwenVLVisionConfig.from_hf(hf["visual"])
+        m = cls(cfg, vcfg, tree["text"], tree["vision"], hf, qtype)
+        m.image_start_id = hf["visual"].get("image_start_id", 151857)
+        return m
+
+
+def _minicpmv_text_family(hf: dict) -> str:
+    """MiniCPM-V carries its LLM arch implicitly: v2.6+ is qwen2, v2.5 is
+    llama (MiniCPM-Llama3-V), earlier is minicpm."""
+    v = float(hf.get("version", 2.6))
+    if v >= 2.6:
+        return "qwen2"
+    if v >= 2.5:
+        return "llama"
+    return "minicpm"
+
+
+def _minicpmv_vision_cfg(hf: dict):
+    from ipex_llm_tpu.models.vision_clip import ClipVisionConfig
+
+    v = hf["vision_config"]
+    return ClipVisionConfig(
+        hidden_size=v["hidden_size"],
+        num_layers=v["num_hidden_layers"],
+        num_heads=v["num_attention_heads"],
+        intermediate_size=v["intermediate_size"],
+        patch_size=v.get("patch_size", 14),
+        image_size=v.get("image_size", 448),
+        norm_eps=v.get("layer_norm_eps", 1e-6),
+        act=v.get("hidden_act", "gelu_pytorch_tanh"),
+        feature_layer=v["num_hidden_layers"],
+        select_strategy="full",
+        variant="siglip",
+    )
+
+
+class TPUMiniCPMVForConditionalGeneration(TPUInternVLForConditionalGeneration):
+    """MiniCPM-V: SigLIP tower (vpm.) + perceiver resampler + llm. text.
+
+    Reference counterpart: transformers/models/minicpmv.py.  Image features
+    enter at ``image_bound`` (start, end) spans — the remote model's own
+    forward contract — each span exactly ``query_num`` tokens wide."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.minicpmv import build_resampler_params
+        from ipex_llm_tpu.models.vision_clip import build_clip_vision_params
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        fam = get_family(_minicpmv_text_family(hf_config))
+        cfg = fam.to_config(hf_config)
+        vcfg = _minicpmv_vision_cfg(hf_config)
+        reader = _AliasReader(CheckpointReader(path))
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_clip_vision_params(
+            vcfg, reader.reader.get, reader.reader.has, qtype)
+        vparams["resampler"] = build_resampler_params(
+            reader.reader.get, reader.reader.has, qtype)
+        m = cls(cfg, vcfg, params, vparams, hf_config, qtype)
+        m.query_num = hf_config.get("query_num", 64)
+        return m
+
+    def _embed_multimodal(self, ids: np.ndarray, pixel_values,
+                          image_bound=None):
+        from ipex_llm_tpu.models.minicpmv import resampler_forward
+        from ipex_llm_tpu.models.vision_clip import clip_vision_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
+        if pixel_values is not None:
+            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            if px.ndim == 3:
+                px = px[None]
+            feats = clip_vision_forward(self.vision_config,
+                                        self.vision_params, px)
+            g = px.shape[-2] // self.vision_config.patch_size
+            gw = px.shape[-1] // self.vision_config.patch_size
+            e = self.vision_params["resampler"]["query"].shape[1]
+            img = resampler_forward(self.vision_params["resampler"], feats,
+                                    max(1, e // 128), (g, gw))
+            bounds = list(image_bound or [])
+            assert len(bounds) == img.shape[0], (
+                f"{len(bounds)} image_bound spans vs {img.shape[0]} images")
+            for j, (s, en) in enumerate(bounds):
+                assert en - s == self.query_num, (
+                    f"span [{s},{en}) != query_num {self.query_num}")
+                x = x.at[0, s:en].set(img[j].astype(x.dtype))
+        return x
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.families import get_family
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        fam = get_family(_minicpmv_text_family(hf))
+        cfg = fam.to_config(hf)
+        m = cls(cfg, _minicpmv_vision_cfg(hf), tree["text"], tree["vision"],
+                hf, qtype)
+        m.query_num = hf.get("query_num", 64)
+        return m
+
+
+class AutoModelForVision2Seq:
+    """Vision-language loader dispatching by model_type (qwen2_vl,
+    internvl, llava, mllama, janus, qwen-vl v1, minicpmv)."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        hf = read_config(str(path))
+        mt = hf.get("model_type")
         if mt == "qwen2_vl":
             return TPUModelForVision2Seq.from_pretrained(str(path), **kwargs)
         if mt == "internvl":
@@ -585,9 +767,17 @@ class AutoModelForVision2Seq:
             return TPUJanusForConditionalGeneration.from_pretrained(
                 str(path), **kwargs
             )
+        if mt == "qwen" and "visual" in hf:
+            return TPUQwenVLForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
+        if mt == "minicpmv":
+            return TPUMiniCPMVForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
         raise ValueError(
             f"AutoModelForVision2Seq supports qwen2_vl/internvl/llava/"
-            f"mllama/janus; got {mt!r}"
+            f"mllama/janus/qwen(-vl v1)/minicpmv; got {mt!r}"
         )
 
     @classmethod
@@ -607,6 +797,10 @@ class AutoModelForVision2Seq:
             return TPULlavaForConditionalGeneration.load_low_bit(str(path))
         if mt == "janus":
             return TPUJanusForConditionalGeneration.load_low_bit(str(path))
+        if mt == "qwen":
+            return TPUQwenVLForConditionalGeneration.load_low_bit(str(path))
+        if mt == "minicpmv":
+            return TPUMiniCPMVForConditionalGeneration.load_low_bit(str(path))
         if mt == "mllama":
             from ipex_llm_tpu.models.mllama import (
                 TPUMllamaForConditionalGeneration,
@@ -614,5 +808,6 @@ class AutoModelForVision2Seq:
 
             return TPUMllamaForConditionalGeneration.load_low_bit(str(path))
         raise ValueError(
-            f"load_low_bit supports qwen2_vl/internvl/llava/mllama; got {mt!r}"
+            f"load_low_bit supports qwen2_vl/internvl/llava/mllama/janus/"
+            f"qwen(-vl v1)/minicpmv; got {mt!r}"
         )

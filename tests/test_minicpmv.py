@@ -1,0 +1,188 @@
+"""MiniCPM-V parity: SigLIP tower vs mainline HF, resampler vs a torch
+nn.MultiheadAttention oracle, full model vs Qwen2 with spliced embeds.
+
+Reference counterpart: transformers/models/minicpmv.py (the reference's
+flagship multimodal family).  The remote modeling code is unavailable, so
+the v2.6 resampler semantics (k = ln_kv(kv_proj(x)) + 2D sincos, v without
+the position term, q = ln_q(query), then ln_post and @proj) are encoded in
+a torch oracle using the genuine nn.MultiheadAttention; the 2D sincos table
+is shared between oracle and implementation (models/minicpmv.sincos_2d).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+VD, NQ, E = 32, 4, 64        # vision dim, queries, llm hidden
+
+
+class OracleResampler(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.query = nn.Parameter(torch.randn(NQ, E) * 0.1)
+        self.kv_proj = nn.Linear(VD, E, bias=False)
+        self.ln_q = nn.LayerNorm(E, eps=1e-6)
+        self.ln_kv = nn.LayerNorm(E, eps=1e-6)
+        self.ln_post = nn.LayerNorm(E, eps=1e-6)
+        self.attn = nn.MultiheadAttention(E, 1, batch_first=True)
+        self.proj = nn.Parameter(torch.randn(E, E) * 0.1)
+
+    def forward(self, feats, grid):
+        from ipex_llm_tpu.models.minicpmv import sincos_2d
+
+        b = feats.shape[0]
+        kv = self.ln_kv(self.kv_proj(feats))
+        pos = torch.from_numpy(sincos_2d(E, *grid))
+        k = kv + pos
+        q = self.ln_q(self.query).unsqueeze(0).expand(b, -1, -1)
+        out = self.attn(q, k, kv, need_weights=False)[0]
+        return self.ln_post(out) @ self.proj
+
+
+def _resampler_tensors(m: OracleResampler) -> dict:
+    r = "resampler."
+    t = {
+        r + "query": m.query,
+        r + "kv_proj.weight": m.kv_proj.weight,
+        r + "proj": m.proj,
+        r + "attn.in_proj_weight": m.attn.in_proj_weight,
+        r + "attn.in_proj_bias": m.attn.in_proj_bias,
+        r + "attn.out_proj.weight": m.attn.out_proj.weight,
+        r + "attn.out_proj.bias": m.attn.out_proj.bias,
+    }
+    for nm in ("ln_q", "ln_kv", "ln_post"):
+        ln = getattr(m, nm)
+        t[r + nm + ".weight"] = ln.weight
+        t[r + nm + ".bias"] = ln.bias
+    return {k: v.detach().float().numpy() for k, v in t.items()}
+
+
+@pytest.fixture(scope="module")
+def minicpmv_ckpt(tmp_path_factory):
+    import safetensors.numpy
+    from transformers import (
+        Qwen2Config,
+        Qwen2ForCausalLM,
+        SiglipVisionConfig,
+        SiglipVisionModel,
+    )
+
+    vcfg = SiglipVisionConfig(
+        hidden_size=VD, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=2, image_size=8, patch_size=4,
+    )
+    torch.manual_seed(0)
+    vpm = SiglipVisionModel(vcfg).eval()
+    torch.manual_seed(1)
+    resampler = OracleResampler().eval()
+
+    tcfg = Qwen2Config(
+        vocab_size=200, hidden_size=E, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    llm = Qwen2ForCausalLM(tcfg).eval()
+
+    tensors = _resampler_tensors(resampler)
+    for k, v in vpm.state_dict().items():
+        # SiglipVisionModel prefixes weights "vision_model." -> "vpm."
+        tensors["vpm." + k.replace("vision_model.", "")] = (
+            v.detach().float().numpy())
+    for k, v in llm.state_dict().items():
+        tensors["llm." + k] = v.detach().float().numpy()
+
+    config = {
+        "model_type": "minicpmv", "version": 2.6, "query_num": NQ,
+        "vocab_size": 200, "hidden_size": E, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "rms_norm_eps": 1e-6,
+        "max_position_embeddings": 256,
+        "vision_config": {"hidden_size": VD, "intermediate_size": 64,
+                          "num_hidden_layers": 2, "num_attention_heads": 2,
+                          "image_size": 8, "patch_size": 4,
+                          "hidden_act": "gelu_pytorch_tanh",
+                          "layer_norm_eps": 1e-6},
+    }
+    path = tmp_path_factory.mktemp("minicpmv") / "m"
+    path.mkdir()
+    safetensors.numpy.save_file(
+        {k: np.ascontiguousarray(v) for k, v in tensors.items()},
+        str(path / "model.safetensors"))
+    (path / "config.json").write_text(json.dumps(config))
+    return vpm, resampler, llm, str(path)
+
+
+def test_minicpmv_siglip_tower_parity(minicpmv_ckpt):
+    """Tower vs MAINLINE SiglipVisionModel — a true independent oracle."""
+    vpm, _, _, path = minicpmv_ckpt
+    rng = np.random.default_rng(3)
+    pixels = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    with torch.no_grad():
+        want = vpm(torch.from_numpy(pixels)).last_hidden_state.float().numpy()
+
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.models.vision_clip import clip_vision_forward
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(clip_vision_forward(
+        m.vision_config, m.vision_params, jnp.asarray(pixels)))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+
+
+def test_minicpmv_full_model_parity(minicpmv_ckpt):
+    vpm, resampler, llm, path = minicpmv_ckpt
+    rng = np.random.default_rng(4)
+    pixels = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    ids = np.asarray([5, 9] + [7] * NQ + [11, 13], np.int32)
+    bound = [(2, 2 + NQ)]
+
+    with torch.no_grad():
+        feats = vpm(torch.from_numpy(pixels)).last_hidden_state
+        img = resampler(feats, (2, 2))
+        emb = llm.get_input_embeddings()(
+            torch.from_numpy(ids[None].astype(np.int64)))
+        emb[0, 2 : 2 + NQ] = img[0]
+        want = llm(inputs_embeds=emb).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m.forward_logits(ids, pixel_values=pixels,
+                                      image_bound=bound))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+    # text-only path through the same class
+    ids_t = np.asarray([5, 9, 11, 13], np.int32)
+    with torch.no_grad():
+        want_t = llm(torch.from_numpy(ids_t[None].astype(np.int64))
+                     ).logits.float().numpy()
+    got_t = np.asarray(m.forward_logits(ids_t))
+    assert np.abs(got_t - want_t).max() / np.abs(want_t).max() < 0.06
+
+
+def test_sincos_channel_order():
+    """Pin the upstream MAE channel order: first half encodes the COLUMN
+    index (get_2d_sincos_pos_embed uses meshgrid(grid_w, grid_h))."""
+    from ipex_llm_tpu.models.minicpmv import sincos_2d
+
+    emb = sincos_2d(8, 1, 3)     # one row, three columns
+    first, second = emb[:, :4], emb[:, 4:]
+    # columns differ -> first half varies across positions
+    assert not np.allclose(first[0], first[1])
+    # the row index is constant -> second half identical everywhere
+    assert np.allclose(second[0], second[1]) and np.allclose(second[0],
+                                                             second[2])
+
+    emb2 = sincos_2d(8, 3, 1)    # three rows, one column
+    assert np.allclose(emb2[0, :4], emb2[1, :4])      # column constant
+    assert not np.allclose(emb2[0, 4:], emb2[1, 4:])  # rows differ
