@@ -214,3 +214,38 @@ class TPUBertModel:
             emb = (h * m).sum(1) / np.maximum(m.sum(1), 1e-9)
         return emb / np.maximum(np.linalg.norm(emb, axis=-1, keepdims=True),
                                 1e-12)
+
+
+class TPUBertForSequenceClassification(TPUBertModel):
+    """Classifier/reranker head on the encoder (bge-reranker-class models).
+
+    HF semantics: logits = classifier(pooler(cls)) — the pooled tanh
+    projection feeds a linear head (``num_labels`` wide; 1 for rerankers)."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        m = super().from_pretrained(path, **kwargs)
+        from ipex_llm_tpu.models.build import quantize_weight
+        from ipex_llm_tpu.models.loader import CheckpointReader
+
+        reader = CheckpointReader(path)
+        self_ = cls(m.config, m.params, m.hf_config, m.qtype)
+        self_.params["classifier"] = quantize_weight(
+            reader.get("classifier.weight"), m.qtype)
+        self_.params["classifier_b"] = jnp.asarray(
+            reader.get("classifier.bias"), jnp.float32)
+        return self_
+
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        hidden, pooled = super().__call__(input_ids, attention_mask,
+                                          token_type_ids)
+        if pooled is None:
+            raise ValueError("classification checkpoint has no pooler")
+        logits = linear_ops.linear(
+            pooled.astype(jnp.bfloat16), self.params["classifier"],
+            self.params["classifier_b"]).astype(jnp.float32)
+        return logits
+
+    def score(self, input_ids, attention_mask=None) -> np.ndarray:
+        """Reranker convenience: [B] relevance scores (num_labels == 1)."""
+        return np.asarray(self(input_ids, attention_mask))[:, 0]

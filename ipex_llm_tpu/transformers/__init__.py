@@ -8,6 +8,7 @@ from ipex_llm_tpu.transformers.model import (
     AutoModel,
     AutoModelForCausalLM,
     AutoModelForSeq2SeqLM,
+    AutoModelForSequenceClassification,
     AutoModelForSpeechSeq2Seq,
     TPUModelForCausalLM,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "AutoModel",
     "AutoModelForCausalLM",
     "AutoModelForSeq2SeqLM",
+    "AutoModelForSequenceClassification",
     "AutoModelForSpeechSeq2Seq",
     "AutoModelForVision2Seq",
     "TPUModelForCausalLM",

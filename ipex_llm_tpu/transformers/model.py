@@ -412,6 +412,29 @@ class AutoModelForSpeechSeq2Seq:
         )
 
 
+class AutoModelForSequenceClassification:
+    """Encoder classifier / reranker loader (reference model.py Auto list).
+
+    Dispatches bert-style checkpoints to the TPU encoder + classifier head;
+    other architectures fail loudly."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        hf = read_config(str(path))
+        if hf.get("model_type") == "bert":
+            from ipex_llm_tpu.models.bert import (
+                TPUBertForSequenceClassification,
+            )
+
+            qtype = _resolve_qtype(kwargs)
+            return TPUBertForSequenceClassification.from_pretrained(
+                str(path), load_in_low_bit=qtype)
+        raise NotImplementedError(
+            f"AutoModelForSequenceClassification supports bert-style "
+            f"encoders; got {hf.get('model_type')!r}"
+        )
+
+
 class AutoModelForSeq2SeqLM(_NotYetSupported):
     pass
 

@@ -531,3 +531,34 @@ def test_health_reflects_engine_state(tiny_ckpt):
             return True
 
     assert asyncio.run(run())
+
+
+def test_bert_sequence_classification_reranker(tmp_path):
+    """AutoModelForSequenceClassification over the encoder (bge-reranker
+    pattern: num_labels=1 relevance scores)."""
+    from transformers import BertConfig, BertForSequenceClassification
+
+    cfg = BertConfig(vocab_size=120, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=64, num_labels=1)
+    torch.manual_seed(5)
+    hf = BertForSequenceClassification(cfg).eval()
+    path = str(tmp_path / "reranker")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    ids = np.random.default_rng(6).integers(0, 120, (3, 9)).astype(np.int64)
+    mask = np.ones((3, 9), np.int64)
+    mask[2, 5:] = 0
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids),
+                  attention_mask=torch.from_numpy(mask)).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForSequenceClassification
+
+    m = AutoModelForSequenceClassification.from_pretrained(
+        path, load_in_low_bit="bf16")
+    got = np.asarray(m(ids, attention_mask=mask))
+    assert np.abs(got - want).max() / max(np.abs(want).max(), 1e-3) < 0.06
+    scores = m.score(ids, attention_mask=mask)
+    assert scores.shape == (3,)
+    assert np.allclose(scores, got[:, 0])
