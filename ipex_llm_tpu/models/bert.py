@@ -249,3 +249,50 @@ class TPUBertForSequenceClassification(TPUBertModel):
     def score(self, input_ids, attention_mask=None) -> np.ndarray:
         """Reranker convenience: [B] relevance scores (num_labels == 1)."""
         return np.asarray(self(input_ids, attention_mask))[:, 0]
+
+
+class TPUBertForMaskedLM(TPUBertModel):
+    """MLM head: logits = decoder(gelu+LN transform(hidden)) (HF cls
+    naming; decoder weight usually tied to the word embedding)."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        m = super().from_pretrained(path, **kwargs)
+        from ipex_llm_tpu.models.build import quantize_weight
+        from ipex_llm_tpu.models.loader import CheckpointReader
+
+        reader = CheckpointReader(path)
+        self_ = cls(m.config, m.params, m.hf_config, m.qtype)
+        p = "cls.predictions."
+        self_.params["mlm_dense"] = quantize_weight(
+            reader.get(p + "transform.dense.weight"), m.qtype)
+        self_.params["mlm_dense_b"] = jnp.asarray(
+            reader.get(p + "transform.dense.bias"), jnp.float32)
+        self_.params["mlm_ln"] = jnp.asarray(
+            reader.get(p + "transform.LayerNorm.weight"), jnp.float32)
+        self_.params["mlm_ln_b"] = jnp.asarray(
+            reader.get(p + "transform.LayerNorm.bias"), jnp.float32)
+        dec = (reader.get(p + "decoder.weight")
+               if reader.has(p + "decoder.weight")
+               else np.asarray(self_.params["word"], np.float32))
+        self_.params["mlm_decoder"] = quantize_weight(dec, m.qtype)
+        self_.params["mlm_decoder_b"] = jnp.asarray(
+            reader.get(p + "bias"), jnp.float32)
+        return self_
+
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        hidden, _ = TPUBertModel.__call__(self, input_ids, attention_mask,
+                                          token_type_ids)
+        from ipex_llm_tpu.ops import mlp as mlp_ops
+
+        h = mlp_ops.act(
+            linear_ops.linear(hidden.astype(jnp.bfloat16),
+                              self.params["mlm_dense"],
+                              self.params["mlm_dense_b"]),
+            self.config.act).astype(jnp.float32)
+        h = layer_norm(h, self.params["mlm_ln"], self.params["mlm_ln_b"],
+                       self.config.norm_eps)
+        return linear_ops.linear(h.astype(jnp.bfloat16),
+                                 self.params["mlm_decoder"],
+                                 self.params["mlm_decoder_b"]
+                                 ).astype(jnp.float32)

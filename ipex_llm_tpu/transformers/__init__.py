@@ -7,6 +7,7 @@
 from ipex_llm_tpu.transformers.model import (
     AutoModel,
     AutoModelForCausalLM,
+    AutoModelForMaskedLM,
     AutoModelForSeq2SeqLM,
     AutoModelForSequenceClassification,
     AutoModelForSpeechSeq2Seq,
@@ -20,6 +21,7 @@ from ipex_llm_tpu.transformers.multimodal import (
 __all__ = [
     "AutoModel",
     "AutoModelForCausalLM",
+    "AutoModelForMaskedLM",
     "AutoModelForSeq2SeqLM",
     "AutoModelForSequenceClassification",
     "AutoModelForSpeechSeq2Seq",

@@ -435,6 +435,24 @@ class AutoModelForSequenceClassification:
         )
 
 
+class AutoModelForMaskedLM:
+    """Encoder MLM loader (reference model.py Auto list)."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        hf = read_config(str(path))
+        if hf.get("model_type") == "bert":
+            from ipex_llm_tpu.models.bert import TPUBertForMaskedLM
+
+            qtype = _resolve_qtype(kwargs)
+            return TPUBertForMaskedLM.from_pretrained(
+                str(path), load_in_low_bit=qtype)
+        raise NotImplementedError(
+            f"AutoModelForMaskedLM supports bert-style encoders; got "
+            f"{hf.get('model_type')!r}"
+        )
+
+
 class AutoModelForSeq2SeqLM(_NotYetSupported):
     pass
 

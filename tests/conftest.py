@@ -13,6 +13,8 @@ same config API.  The benchmark (bench.py) is what exercises the real chip.
 
 import os
 
+import pytest
+
 # no persistent XLA cache in tests: CPU AOT cache entries are machine-feature
 # sensitive (loader warns / may SIGILL across heterogeneous CI hosts)
 os.environ["IPEX_LLM_TPU_COMPILE_CACHE"] = ""
@@ -25,3 +27,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled executables after each test module.
+
+    The full suite accumulates hundreds of XLA:CPU executables; past ~230
+    tests the CPU client reproducibly SEGFAULTS inside
+    backend_compile_and_load (observed twice at the same test).  Dropping
+    caches between modules bounds the live-executable count; per-module
+    caching (the expensive shared decoder programs) is unaffected."""
+    yield
+    import jax
+
+    jax.clear_caches()

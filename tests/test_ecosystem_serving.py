@@ -562,3 +562,26 @@ def test_bert_sequence_classification_reranker(tmp_path):
     scores = m.score(ids, attention_mask=mask)
     assert scores.shape == (3,)
     assert np.allclose(scores, got[:, 0])
+
+
+def test_bert_masked_lm(tmp_path):
+    from transformers import BertConfig, BertForMaskedLM
+
+    cfg = BertConfig(vocab_size=120, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=64)
+    torch.manual_seed(7)
+    hf = BertForMaskedLM(cfg).eval()
+    path = str(tmp_path / "mlm")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    ids = np.random.default_rng(8).integers(0, 120, (2, 9)).astype(np.int64)
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids)).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForMaskedLM
+
+    m = AutoModelForMaskedLM.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m(ids))
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85

@@ -168,3 +168,36 @@ def test_param_shardings_shapes(cfg_params):
     down = sharded["layers"]["down"]
     ddb = down.data.sharding.shard_shape(down.data.shape)
     assert ddb[-2] == down.data.shape[-2] // 8
+
+
+def test_mla_deepseek_tp_logits_match(tmp_path):
+    """DeepSeek MLA (low-rank q/kv, unbalanced head dims) under a tp mesh
+    must match the single-device logits — covers the q_a/kv_a col-parallel
+    rules plus replicated q_b/kv_b."""
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    cfg = DeepseekV2Config(
+        vocab_size=160, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        q_lora_rank=48, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, n_routed_experts=None,
+        first_k_dense_replace=99, max_position_embeddings=256,
+        attention_bias=False, tie_word_embeddings=False,
+    )
+    torch.manual_seed(21)
+    path = str(tmp_path / "dsv2")
+    DeepseekV2ForCausalLM(cfg).eval().save_pretrained(
+        path, safe_serialization=True)
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    tokens = np.random.default_rng(2).integers(0, 160, (2, 9)).astype(np.int32)
+    m0 = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    want = np.asarray(m0(tokens))
+
+    mesh = make_mesh(MeshSpec(tp=2))
+    m1 = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16",
+                                              mesh=mesh)
+    got = np.asarray(m1(tokens))
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.02
