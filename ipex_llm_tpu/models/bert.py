@@ -283,8 +283,6 @@ class TPUBertForMaskedLM(TPUBertModel):
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
         hidden, _ = TPUBertModel.__call__(self, input_ids, attention_mask,
                                           token_type_ids)
-        from ipex_llm_tpu.ops import mlp as mlp_ops
-
         h = mlp_ops.act(
             linear_ops.linear(hidden.astype(jnp.bfloat16),
                               self.params["mlm_dense"],

@@ -340,4 +340,9 @@ def build_params(
             cfg.rope.inv_freq(cfg.max_position_embeddings), jnp.float32
         )
         params["rope_mscale"] = float(cfg.rope.mscale(cfg.max_position_embeddings))
+        if cfg.rope_local is not None:   # gemma3 sliding-layer table
+            params["inv_freq_local"] = jnp.asarray(
+                cfg.rope_local.inv_freq(cfg.max_position_embeddings),
+                jnp.float32,
+            )
     return params

@@ -40,6 +40,8 @@ class ModelConfig:
     # rope
     rope: RopeScaling | None = None
     rope_layout: str = "half"     # half | two
+    # gemma3: sliding-attention layers use a separate (local) rope table
+    rope_local: RopeScaling | None = None
     partial_rotary: float = 1.0
     mrope_section: tuple[int, ...] | None = None  # qwen2-vl 3-channel rope
 

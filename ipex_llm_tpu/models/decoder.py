@@ -37,7 +37,7 @@ COMPUTE_DTYPE = jnp.bfloat16
 # inv_freq as a torch buffer).  Single source of truth: decoder_forward
 # stop_gradients them (no grad flow) and training/step.py zeroes their
 # optimizer updates (no adamw weight-decay drift) from this same list.
-FROZEN_BUFFER_KEYS = ("inv_freq", "rope_mscale")
+FROZEN_BUFFER_KEYS = ("inv_freq", "inv_freq_local", "rope_mscale")
 
 
 def _norm(x, w, cfg: ModelConfig, bias=None):
@@ -351,6 +351,18 @@ def embed_prelude(cfg: ModelConfig, params, tokens, rope_positions,
     return x, cos, sin
 
 
+def local_rope_tables(cfg: ModelConfig, params, rope_positions):
+    """gemma3: sliding layers rope with a separate local-frequency table
+    (cfg.rope_local -> params["inv_freq_local"]); None for other models."""
+    if "inv_freq_local" not in params or cfg.rope is None \
+            or cfg.mrope_section is not None:
+        return None, None
+    inv = params["inv_freq_local"]
+    if not isinstance(inv, (float, int)):
+        inv = jax.lax.stop_gradient(inv)
+    return rope_ops.cos_sin(rope_positions, inv, 1.0)
+
+
 def alibi_bias_for(cfg: ModelConfig, q_slots, s: int):
     """ALiBi bias [B, H, T, S] (bloom/mpt/baichuan-13b): slope *
     (k_pos - q_pos), identical for every layer — built ONCE per forward
@@ -386,7 +398,8 @@ def logits_tail(cfg: ModelConfig, params, x):
 
 def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
                x, cos, sin, slot0, q_slots, kv_len, kv_start, cache,
-               collect_obs: int = 0, alibi_bias=None):
+               collect_obs: int = 0, alibi_bias=None,
+               cos_local=None, sin_local=None):
     """Scan one stacked layer tree over its cache slice.
 
     The single compiled layer body shared by decoder_forward and the
@@ -397,8 +410,14 @@ def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
 
     def body(x, xs):
         lp, kl, vl, sliding = xs
+        if cos_local is not None:
+            # gemma3 dual rope: sliding layers use the local table
+            c = jnp.where(sliding, cos_local, cos)
+            s_ = jnp.where(sliding, sin_local, sin)
+        else:
+            c, s_ = cos, sin
         attn_out, kl, vl, obs_q = _attention_block(
-            cfg, lp, x, kl, vl, cos, sin, slot0, q_slots, kv_len, kv_start,
+            cfg, lp, x, kl, vl, c, s_, slot0, q_slots, kv_len, kv_start,
             sliding, cache, collect_obs, bias=alibi_bias,
         )
         ffn = _moe_block if "moe_gate_up" in lp else _mlp_block
@@ -453,6 +472,7 @@ def decoder_forward(
     embed = params["embed"]
     x, cos, sin = embed_prelude(cfg, params, tokens, rope_positions,
                                 input_embeds)
+    cos_l, sin_l = local_rope_tables(cfg, params, rope_positions)
 
     alibi_bias = None
 
@@ -486,7 +506,7 @@ def decoder_forward(
         x, kp, vp, op = run_layers(
             cfg, tree, cache.k[lo:hi], cache.v[lo:hi], sliding_flags[lo:hi],
             x, cos, sin, slot0, q_slots, kv_len, kv_start, cache,
-            collect_obs, alibi_bias,
+            collect_obs, alibi_bias, cos_local=cos_l, sin_local=sin_l,
         )
         k_parts.append(kp)
         v_parts.append(vp)

@@ -182,6 +182,41 @@ def _gemma2(hf: dict) -> ModelConfig:
     return ModelConfig(**d)
 
 
+def _gemma3(hf: dict) -> ModelConfig:
+    """gemma3 text: gemma2 block layout (pre/post feedforward norms) plus
+    per-head q/k RMSNorm and DUAL rope — sliding layers (5:1 pattern) use a
+    local-frequency table, full layers the global (scaled) one."""
+    n_layers = hf["num_hidden_layers"]
+    head_dim = hf.get("head_dim", 256)
+    hf2 = dict(hf)
+    hf2["head_dim"] = head_dim
+    pattern = hf.get("sliding_window_pattern", 6)
+    layer_types = tuple(
+        hf["layer_types"] if hf.get("layer_types") else (
+            "sliding_attention" if (i + 1) % pattern else "full_attention"
+            for i in range(n_layers))
+    )
+    d = _base_cfg(
+        hf2,
+        norm_offset=1.0,
+        act=hf.get("hidden_activation",
+                   hf.get("hidden_act", "gelu_pytorch_tanh")),
+        embedding_multiplier=float(np.sqrt(hf["hidden_size"])),
+        tie_word_embeddings=hf.get("tie_word_embeddings", True),
+        post_attn_norm=True,
+        post_mlp_norm=True,
+        qk_norm=True,
+        sliding_window=hf.get("sliding_window", 512),
+        layer_types=layer_types,
+        attn_scale=float(hf.get("query_pre_attn_scalar", 256)) ** -0.5,
+        rope_local=RopeScaling(
+            head_dim=head_dim,
+            base=hf.get("rope_local_base_freq", 10000.0),
+        ),
+    )
+    return ModelConfig(**d)
+
+
 _GEMMA_SCHEME = WeightScheme(lm_head="model.embed_tokens.weight")
 _GEMMA2_SCHEME = WeightScheme(
     lm_head="model.embed_tokens.weight",
@@ -955,6 +990,18 @@ FAMILIES: dict[str, Family] = {
     ),
     "gemma": Family("gemma", _gemma, _GEMMA_SCHEME),
     "gemma2": Family("gemma2", _gemma2, _GEMMA2_SCHEME),
+    "gemma3_text": Family(
+        "gemma3_text",
+        _gemma3,
+        WeightScheme(
+            lm_head="model.embed_tokens.weight",
+            mlp_norm="model.layers.{i}.pre_feedforward_layernorm.weight",
+            post_attn_norm="model.layers.{i}.post_attention_layernorm.weight",
+            post_mlp_norm="model.layers.{i}.post_feedforward_layernorm.weight",
+            q_norm="model.layers.{i}.self_attn.q_norm.weight",
+            k_norm="model.layers.{i}.self_attn.k_norm.weight",
+        ),
+    ),
     "phi": Family("phi", _phi, _PHI_SCHEME),
     "gpt_neox": Family("gpt_neox", _gptneox, _GPTNEOX_SCHEME,
                        qkv_transform=_neox_qkv),

@@ -62,6 +62,7 @@ def pipeline_forward(
     from ipex_llm_tpu.models.decoder import (
         alibi_bias_for,
         embed_prelude,
+        local_rope_tables,
         logits_tail,
         run_layers,
     )
@@ -81,10 +82,13 @@ def pipeline_forward(
     # own partial copy of family semantics
     pos = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     x, cos, sin = embed_prelude(cfg, params, tokens, pos)
+    cos_l, sin_l = local_rope_tables(cfg, params, pos)
     mbs = x.reshape(n_micro, bm, t, x.shape[-1])
     # rows are position-identical: slice per-microbatch cos/sin views
     cos = None if cos is None else cos[:bm]
     sin = None if sin is None else sin[:bm]
+    cos_l = None if cos_l is None else cos_l[:bm]
+    sin_l = None if sin_l is None else sin_l[:bm]
 
     q_slots = jnp.broadcast_to(jnp.arange(t)[None, :], (bm, t))
     kv_len = jnp.full((bm,), t, jnp.int32)
@@ -105,7 +109,7 @@ def pipeline_forward(
             y, _, _, _ = run_layers(
                 cfg, layer_tree, cache.k, cache.v, flags, xa, cos, sin,
                 jnp.asarray(0, jnp.int32), q_slots, kv_len, None, cache,
-                alibi_bias=alibi_bias,
+                alibi_bias=alibi_bias, cos_local=cos_l, sin_local=sin_l,
             )
             return y
 

@@ -265,3 +265,35 @@ def test_decilm_variable_gqa(tmp_path):
     path = _save_synthetic(tmp_path, "decilm", config, tensors)
     got = _load_logits(path)
     assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_gemma3_dual_rope_logits(tmp_path):
+    """gemma3: 5:1 sliding/full pattern with DIFFERENT rope tables per
+    layer type plus per-head q/k norms (gemma 1+w offset)."""
+    from transformers import Gemma3ForCausalLM, Gemma3TextConfig
+
+    cfg = Gemma3TextConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        sliding_window=8, sliding_window_pattern=2,
+        rope_theta=1000000.0, rope_local_base_freq=10000.0,
+        query_pre_attn_scalar=16,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+    )
+    torch.manual_seed(17)
+    hf = Gemma3ForCausalLM(cfg).eval()
+    path = str(tmp_path / "gemma3")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    # long enough that sliding (8) and full attention genuinely differ
+    toks = np.random.default_rng(18).integers(0, 150, (1, 24)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks).long()).logits.float().numpy()
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m(toks))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
