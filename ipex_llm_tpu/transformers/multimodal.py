@@ -737,9 +737,123 @@ class TPUMiniCPMVForConditionalGeneration(TPUInternVLForConditionalGeneration):
         return m
 
 
+class TPUGemma3ForConditionalGeneration(TPUInternVLForConditionalGeneration):
+    """Gemma3 VLM: SigLIP tower + avg-pool/RMSNorm/matmul projector +
+    gemma3_text, via embed replacement at ``image_token_index``.
+
+    HF splices raw projector outputs into ALREADY-SCALED text embeddings;
+    the shared decoder applies the gemma embedding multiplier to the whole
+    input_embeds tensor, so image features are pre-divided by it here."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.build import quantize_weight
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_clip import (
+            ClipVisionConfig,
+            build_clip_vision_params,
+        )
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        text = dict(hf_config["text_config"])
+        text.setdefault("model_type", "gemma3_text")
+        fam = get_family("gemma3_text")
+        cfg = fam.to_config(text)
+        v = hf_config["vision_config"]
+        reader = _AliasReader(CheckpointReader(path))
+        prefix = "model.vision_tower.vision_model."
+        if not reader.reader.has(prefix + "embeddings.patch_embedding.weight"):
+            prefix = "vision_tower.vision_model."
+        vcfg = ClipVisionConfig(
+            hidden_size=v["hidden_size"],
+            num_layers=v["num_hidden_layers"],
+            num_heads=v["num_attention_heads"],
+            intermediate_size=v["intermediate_size"],
+            patch_size=v.get("patch_size", 14),
+            image_size=v.get("image_size", 896),
+            norm_eps=v.get("layer_norm_eps", 1e-6),
+            act=v.get("hidden_act", "gelu_pytorch_tanh"),
+            feature_layer=v["num_hidden_layers"],
+            select_strategy="full",
+            variant="siglip",
+            prefix=prefix,
+        )
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_clip_vision_params(
+            vcfg, reader.reader.get, reader.reader.has, qtype)
+        mp = prefix.replace("vision_tower.vision_model.",
+                            "multi_modal_projector.")
+        vparams["proj_norm"] = jnp.asarray(
+            reader.reader.get(mp + "mm_soft_emb_norm.weight"), jnp.float32)
+        vparams["proj_w"] = quantize_weight(
+            np.ascontiguousarray(
+                reader.reader.get(mp + "mm_input_projection_weight").T),
+            qtype)
+        m = cls(cfg, vcfg, params, vparams, hf_config, qtype)
+        m.image_token_id = hf_config.get("image_token_index", 262144)
+        m.mm_tokens_per_image = hf_config.get("mm_tokens_per_image", 256)
+        return m
+
+    def _project(self, feats):
+        """avg-pool the patch grid to mm_tokens_per_image, RMS-norm (gemma
+        1+w), then matmul into the text width (Gemma3MultiModalProjector)."""
+        from ipex_llm_tpu.ops.norms import rms_norm
+
+        b, n, d = feats.shape
+        g = int(np.sqrt(n))
+        side = int(np.sqrt(self.mm_tokens_per_image))
+        k = g // side
+        pooled = feats.reshape(b, side, k, side, k, d).mean(axis=(2, 4))
+        pooled = pooled.reshape(b, side * side, d)
+        normed = rms_norm(pooled, self.vision_params["proj_norm"],
+                          self.config.norm_eps, offset=1.0)
+        from ipex_llm_tpu.ops import linear as linear_ops
+
+        return linear_ops.linear(normed.astype(jnp.bfloat16),
+                                 self.vision_params["proj_w"]
+                                 ).astype(jnp.float32)
+
+    def _embed_multimodal(self, ids: np.ndarray, pixel_values):
+        from ipex_llm_tpu.models.vision_clip import clip_vision_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
+        if pixel_values is not None:
+            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            if px.ndim == 3:
+                px = px[None]
+            feats = clip_vision_forward(self.vision_config,
+                                        self.vision_params, px)
+            img = self._project(feats).reshape(-1, x.shape[-1])
+            # decoder scales input_embeds by the gemma multiplier; HF
+            # splices image features unscaled — pre-divide to compensate
+            img = img / jnp.asarray(self.config.embedding_multiplier,
+                                    img.dtype)
+            (idx,) = np.nonzero(np.asarray(ids) == self.image_token_id)
+            assert len(idx) == img.shape[0], (
+                f"{len(idx)} image tokens vs {img.shape[0]} image embeds")
+            x = x.at[0, jnp.asarray(idx)].set(img.astype(x.dtype))
+        return x
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        raise NotImplementedError(
+            "gemma3 load_low_bit: re-quantize with from_pretrained")
+
+    def save_low_bit(self, path: str) -> None:
+        raise NotImplementedError(
+            "gemma3 save_low_bit not implemented; reload from the HF "
+            "checkpoint instead")
+
+
 class AutoModelForVision2Seq:
     """Vision-language loader dispatching by model_type (qwen2_vl,
-    internvl, llava, mllama, janus, qwen-vl v1, minicpmv)."""
+    internvl, llava, mllama, janus, qwen-vl v1, minicpmv, gemma3)."""
 
     @classmethod
     def from_pretrained(cls, path: str, **kwargs):
@@ -773,6 +887,10 @@ class AutoModelForVision2Seq:
             )
         if mt == "minicpmv":
             return TPUMiniCPMVForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
+        if mt == "gemma3":
+            return TPUGemma3ForConditionalGeneration.from_pretrained(
                 str(path), **kwargs
             )
         raise ValueError(

@@ -795,3 +795,56 @@ def test_janus_save_load_low_bit(tmp_path):
     m2 = AutoModelForVision2Seq.load_low_bit(out)
     got = np.asarray(m2.forward_logits(ids, pixel_values=pixels))
     assert np.allclose(got, want, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# gemma3 VLM (SigLIP tower + avg-pool projector + gemma3 text)
+# ---------------------------------------------------------------------------
+
+
+def test_gemma3_vlm_logits_parity(tmp_path):
+    from transformers import Gemma3Config, Gemma3ForConditionalGeneration
+
+    cfg = Gemma3Config(
+        text_config=dict(
+            vocab_size=300, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, sliding_window=8,
+            layer_types=["sliding_attention", "full_attention"],
+            rope_theta=1000000.0, rope_local_base_freq=10000.0,
+            query_pre_attn_scalar=16, max_position_embeddings=256),
+        vision_config=dict(hidden_size=32, intermediate_size=64,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           image_size=16, patch_size=4),
+        mm_tokens_per_image=4, image_token_index=299,
+        boi_token_index=297, eoi_token_index=298,
+    )
+    torch.manual_seed(0)
+    hf = Gemma3ForConditionalGeneration(cfg).eval()
+    path = str(tmp_path / "gemma3vlm")
+    hf.save_pretrained(path, safe_serialization=True)
+
+    rng = np.random.default_rng(19)
+    pixels = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    ids = np.asarray([5, 297] + [299] * 4 + [298, 7, 11], np.int32)
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(ids[None].astype(np.int64)),
+            pixel_values=torch.from_numpy(pixels),
+        ).logits.float().numpy()
+
+    from ipex_llm_tpu.transformers import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    got = np.asarray(m.forward_logits(ids, pixel_values=pixels))
+    err = np.abs(got - want).max() / np.abs(want).max()
+    assert err < 0.06, err
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+    # text-only path
+    ids_t = np.asarray([5, 7, 11, 13], np.int32)
+    with torch.no_grad():
+        want_t = hf(input_ids=torch.from_numpy(ids_t[None].astype(np.int64))
+                    ).logits.float().numpy()
+    got_t = np.asarray(m.forward_logits(ids_t))
+    assert np.abs(got_t - want_t).max() / np.abs(want_t).max() < 0.06
