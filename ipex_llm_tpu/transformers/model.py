@@ -99,6 +99,10 @@ class TPUModelForCausalLM:
         kwargs.pop("optimize_model", True)
         kwargs.pop("torch_dtype", None)
         kwargs.pop("trust_remote_code", None)
+        # reference model.py: model_hub="modelscope" switches the download
+        # hub; this environment is zero-egress so only local paths load —
+        # the kwarg is accepted for script compatibility
+        kwargs.pop("model_hub", None)
 
         hf_config = read_config(path)
         if hf_config.get("model_type") == "bert":
@@ -453,8 +457,26 @@ class AutoModelForMaskedLM:
         )
 
 
-class AutoModelForSeq2SeqLM(_NotYetSupported):
-    pass
+class AutoModelForSeq2SeqLM:
+    """Seq2seq loader: whisper checkpoints route to the encoder-decoder
+    module; other seq2seq architectures (t5/bart) fail loudly."""
+
+    @classmethod
+    def from_pretrained(cls, path: str, *args, **kwargs):
+        hf = read_config(str(path))
+        if hf.get("model_type") == "whisper":
+            from ipex_llm_tpu.models.whisper import (
+                TPUWhisperForConditionalGeneration,
+            )
+
+            return TPUWhisperForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
+        raise NotImplementedError(
+            f"AutoModelForSeq2SeqLM supports whisper; got "
+            f"{hf.get('model_type')!r} (t5/bart-style encoders-decoders "
+            "are not implemented)"
+        )
 
 
 AutoModelForCausalLM = TPUModelForCausalLM

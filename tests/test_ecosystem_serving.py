@@ -585,3 +585,28 @@ def test_bert_masked_lm(tmp_path):
     got = np.asarray(m(ids))
     assert np.abs(got - want).max() / np.abs(want).max() < 0.06
     assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.85
+
+
+def test_seq2seq_auto_routes_whisper(tmp_path):
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    cfg = WhisperConfig(
+        vocab_size=200, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=16,
+        max_source_positions=75, max_target_positions=64,
+        decoder_start_token_id=2, eos_token_id=3, pad_token_id=0,
+        bos_token_id=1, suppress_tokens=None, begin_suppress_tokens=None,
+    )
+    torch.manual_seed(9)
+    path = str(tmp_path / "whisper_s2s")
+    WhisperForConditionalGeneration(cfg).eval().save_pretrained(
+        path, safe_serialization=True)
+
+    from ipex_llm_tpu.transformers import AutoModelForSeq2SeqLM
+
+    m = AutoModelForSeq2SeqLM.from_pretrained(path, load_in_low_bit="sym_int4")
+    feats = np.random.default_rng(10).standard_normal(
+        (1, 16, 150)).astype(np.float32)
+    out = m.generate(feats, max_new_tokens=4)
+    assert out.shape[0] >= 1
