@@ -37,8 +37,9 @@ __all__ = [
 class SamplingParams:
     """vLLM's sampling knobs (the subset the TPU engine implements).
 
-    ``n``/``best_of`` > 1 and beam search are not supported; penalties are
-    accepted but ignored (documented deviation, like the reference's
+    ``n`` > 1 samples n independent completions per prompt (each its own
+    engine row); beam search is not supported and penalties are accepted
+    but ignored (documented deviation, like the reference's
     unsupported-kwarg passthrough)."""
 
     n: int = 1
@@ -53,8 +54,8 @@ class SamplingParams:
     frequency_penalty: float = 0.0
 
     def __post_init__(self):
-        if self.n != 1:
-            raise NotImplementedError("SamplingParams.n > 1 is not supported")
+        if self.n < 1:
+            raise ValueError("SamplingParams.n must be >= 1")
 
 
 @dataclass
@@ -145,19 +146,26 @@ class LLM:
         if prompt_token_ids is None:
             prompt_token_ids = [self._tok(p)["input_ids"] for p in prompts]
         reqs = []
-        for i, ids in enumerate(prompt_token_ids):
-            req = _to_engine_request(ids, sp, self._eos, None)
-            reqs.append(self._engine.submit(req))
+        for ids in prompt_token_ids:
+            # n independent completions per prompt, each its own engine row
+            reqs.append([
+                self._engine.submit(_to_engine_request(ids, sp, self._eos,
+                                                       None))
+                for _ in range(sp.n)
+            ])
         outs = []
-        for i, req in enumerate(reqs):
-            toks = list(stream_tokens(req))
-            text = self._tok.decode(toks, skip_special_tokens=True)
+        for i, group in enumerate(reqs):
+            comps = []
+            for j, req in enumerate(group):
+                toks = list(stream_tokens(req))
+                comps.append(CompletionOutput(
+                    j, self._tok.decode(toks, skip_special_tokens=True),
+                    toks, req.finish_reason))
             outs.append(RequestOutput(
-                request_id=req.request_id,
+                request_id=group[0].request_id,
                 prompt=prompts[i] if prompts is not None else None,
-                prompt_token_ids=list(req.prompt_ids),
-                outputs=[CompletionOutput(0, text, toks,
-                                          req.finish_reason)],
+                prompt_token_ids=list(group[0].prompt_ids),
+                outputs=comps,
                 finished=True,
             ))
         return outs
