@@ -69,12 +69,24 @@ def sample_rows(logits: jnp.ndarray, temps: jnp.ndarray, top_ps: jnp.ndarray,
     """Vectorized per-row sampling for the serving engine: rows with
     temperature 0 take argmax, others sample from the temperature-scaled,
     per-row-nucleus-masked distribution.  logits [R, V]; temps/top_ps [R]."""
+    return sample_rows_with_logprobs(logits, temps, top_ps, key)[0]
+
+
+def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
+                              top_ps: jnp.ndarray, key: jax.Array):
+    """sample_rows plus the chosen token's logprob under the MODEL
+    distribution (raw log-softmax, the OpenAI ``logprobs`` convention —
+    not the temperature/top-p-modified sampling distribution)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-4)[:, None]
     scaled = _top_p_mask(scaled, top_ps)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy)
+    chosen = jnp.where(temps > 0, sampled, greedy)
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), chosen[:, None], axis=-1
+    )[:, 0]
+    return chosen, lp
 
 
 def _transform_logits(logits: jnp.ndarray, params: SamplingParams) -> jnp.ndarray:

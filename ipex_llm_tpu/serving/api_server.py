@@ -267,12 +267,20 @@ class OpenAIServer:
             return await self._stream_sse(request, req, chunk)
 
         text = await self._collect(req)
+        choice = {"index": 0, "text": text,
+                  "finish_reason": self._openai_reason(req.finish_reason)}
+        if body.get("logprobs"):
+            # chosen-token logprobs (top-alternatives not tracked)
+            choice["logprobs"] = {
+                "tokens": [self.tok.decode([t]) for t in req.output_ids],
+                "token_logprobs": [round(lp, 6) for lp in req.logprobs],
+                "top_logprobs": None,
+                "text_offset": [],
+            }
         return web.json_response({
             "id": rid, "object": "text_completion", "created": _now(),
             "model": self.model_name,
-            "choices": [{"index": 0, "text": text,
-                         "finish_reason":
-                             self._openai_reason(req.finish_reason)}],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": len(req.prompt_ids),
                 "completion_tokens": len(req.output_ids),
@@ -344,7 +352,10 @@ class OpenAIServer:
         req = self.engine.submit(self._tgi_request(body))
 
         def chunk(piece, finish, tok):
+            n = len(req.output_ids)
+            lp = req.logprobs[n - 1] if 0 < n <= len(req.logprobs) else 0.0
             return {"token": {"id": int(tok), "text": piece,
+                              "logprob": round(float(lp), 6),
                               "special": False},
                     "generated_text": None}
 

@@ -81,6 +81,7 @@ class Request:
     request_id: str = ""
     # filled by the engine
     output_ids: list[int] = field(default_factory=list)
+    logprobs: list[float] = field(default_factory=list)  # per output token
     finish_reason: str | None = None
     first_token_s: float = 0.0
     submitted_s: float = field(default_factory=time.perf_counter)
@@ -168,16 +169,16 @@ def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
     toks [R] current token per row; row_lens [R] tokens already in cache.
     Returns (next_tokens [R], cache, key).
     """
-    from ipex_llm_tpu.ops.sampling import sample_rows
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
     logits, cache = decoder_forward(
         cfg, params, toks[:, None], cache, row_lens[:, None],
         last_token_only=True, slot_offsets=row_lens,
     )
     key, sub = jax.random.split(key)
-    nxt = sample_rows(logits, temps, top_ps, sub)
+    nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub)
     nxt = jnp.where(active, nxt, 0)
-    return nxt, cache, key
+    return nxt, lp, cache, key
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
@@ -401,23 +402,25 @@ class ServingEngine:
         keys = self._row_keys.pop(row, [])
         for i in range(min(len(keys), (n_p - 1) // self.ec.page_size)):
             self.alloc.register_prefix(keys[i], int(self.tables[row, i]))
-        from ipex_llm_tpu.ops.sampling import sample_rows
+        from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
         self.key, sub = jax.random.split(self.key)
-        first = int(np.asarray(sample_rows(
+        first_t, first_lp = sample_rows_with_logprobs(
             logits, jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32), sub,
-        ))[0])
+        )
+        first = int(np.asarray(first_t)[0])
         req.first_token_s = time.perf_counter() - req.submitted_s
         self.toks[row] = first
-        self._emit(row, first)
+        self._emit(row, first, float(np.asarray(first_lp)[0]))
 
-    def _emit(self, row: int, token: int):
+    def _emit(self, row: int, token: int, logprob: float = 0.0):
         req = self.rows[row]
         if req.cancelled:
             self._finish(row, "abort")
             return
         req.output_ids.append(token)
+        req.logprobs.append(logprob)
         req.stream_queue.put(token)
         self.metrics["tokens"] += 1
         if token in req.eos_token_id:
@@ -494,13 +497,14 @@ class ServingEngine:
         if not active.any():
             return
         cache = replace(self.cache, tables=jnp.asarray(self.tables))
-        nxt, self.cache, self.key = _decode_step(
+        nxt, lps, self.cache, self.key = _decode_step(
             self.cfg, self.params, cache,
             jnp.asarray(self.toks), jnp.asarray(self.row_lens),
             jnp.asarray(active), jnp.asarray(self.temps),
             jnp.asarray(self.top_ps), self.key,
         )
         nxt = np.asarray(nxt)
+        lps = np.asarray(lps)
         self.metrics["steps"] += 1
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
         for i in range(len(self.rows)):
@@ -509,7 +513,7 @@ class ServingEngine:
             self.row_lens[i] += 1
             tok = int(nxt[i])
             self.toks[i] = tok
-            self._emit(i, tok)
+            self._emit(i, tok, float(lps[i]))
 
 
 def stream_tokens(req: Request, timeout: float = 120.0):

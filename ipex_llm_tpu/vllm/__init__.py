@@ -160,7 +160,8 @@ class LLM:
                 toks = list(stream_tokens(req))
                 comps.append(CompletionOutput(
                     j, self._tok.decode(toks, skip_special_tokens=True),
-                    toks, req.finish_reason))
+                    toks, req.finish_reason,
+                    cumulative_logprob=float(sum(req.logprobs))))
             outs.append(RequestOutput(
                 request_id=group[0].request_id,
                 prompt=prompts[i] if prompts is not None else None,

@@ -629,3 +629,46 @@ def test_seq2seq_auto_routes_whisper(tmp_path):
         (1, 16, 150)).astype(np.float32)
     out = m.generate(feats, max_new_tokens=4)
     assert out.shape[0] >= 1
+
+
+def test_completions_logprobs(tiny_ckpt):
+    """OpenAI logprobs: per-token chosen logprobs, finite and <= 0."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ipex_llm_tpu.serving.api_server import build_server
+    from ipex_llm_tpu.serving.engine import EngineConfig
+
+    srv = build_server(tiny_ckpt, low_bit="sym_int4",
+                       engine_config=EngineConfig(max_rows=2,
+                                                  max_seq_len=128))
+
+    async def run():
+        async with TestClient(TestServer(srv.app)) as client:
+            r = await client.post("/v1/completions", json={
+                "model": "t", "prompt": "hello", "max_tokens": 5,
+                "temperature": 0, "logprobs": 1})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            lp = body["choices"][0]["logprobs"]
+            n = body["usage"]["completion_tokens"]
+            assert len(lp["token_logprobs"]) == n == len(lp["tokens"])
+            assert all(v <= 0.0 for v in lp["token_logprobs"])
+
+            # TGI stream events carry per-token logprob
+            r2 = await client.post("/generate_stream", json={
+                "inputs": "hello",
+                "parameters": {"max_new_tokens": 3, "do_sample": False}})
+            raw = (await r2.read()).decode()
+            events = [json.loads(x[len("data: "):])
+                      for x in raw.split("\n\n") if x.startswith("data: ")]
+            toks = [e["token"] for e in events if e.get("token")]
+            assert toks and all("logprob" in t and t["logprob"] <= 0.0
+                                for t in toks)
+            return True
+
+    try:
+        assert asyncio.run(run())
+    finally:
+        srv.engine.stop()
