@@ -281,6 +281,15 @@ class TPUModelForCausalLM:
 
         gcfg = (generation_config or self.generation_config).with_kwargs(kwargs)
 
+        # reference lookup.py:63-83: IPEX_LLM_PERFORMANCE_MODE=1 switches
+        # long greedy prompts to prompt-lookup decoding automatically
+        if (os.environ.get("IPEX_LLM_PERFORMANCE_MODE") == "1"
+                and len(rows) == 1 and len(rows[0]) >= 512
+                and streamer is None and not gcfg.do_sample
+                and self.mesh is None):
+            return self.lookup_generate(
+                input_ids, max_new_tokens=gcfg.max_new_tokens)
+
         stream_cb = None
         if streamer is not None:
             def stream_cb(row):  # HF TextStreamer protocol: put(token_ids)

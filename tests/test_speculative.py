@@ -220,3 +220,40 @@ def test_adaptive_th_stop_draft(cfg_params):
         got.sequences[0, : len(prompt) + n],
         fixed.sequences[0, : len(prompt) + n],
     )
+
+
+def test_performance_mode_env_switches_to_lookup(tmp_path, monkeypatch):
+    """IPEX_LLM_PERFORMANCE_MODE=1 auto-enables prompt-lookup decoding for
+    long greedy single prompts (reference lookup.py:63-83)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=160, hidden_size=64, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, tie_word_embeddings=False,
+                      max_position_embeddings=2048)
+    torch.manual_seed(0)
+    path = str(tmp_path / "m")
+    LlamaForCausalLM(cfg).eval().save_pretrained(path,
+                                                 safe_serialization=True)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    prompt = np.tile(np.arange(16, dtype=np.int32), 40)[None]  # 640 tokens
+    base = m.generate(prompt, max_new_tokens=8)
+
+    called = {}
+    orig = m.lookup_generate
+
+    def spy(*a, **k):
+        called["yes"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(m, "lookup_generate", spy)
+    monkeypatch.setenv("IPEX_LLM_PERFORMANCE_MODE", "1")
+    fast = m.generate(prompt, max_new_tokens=8)
+    assert called.get("yes"), "performance mode did not engage lookup"
+    assert np.asarray(fast).shape[-1] >= prompt.shape[-1]
+    # greedy results agree (lookup is exact for greedy)
+    n = min(np.asarray(base).shape[-1], np.asarray(fast).shape[-1])
+    assert (np.asarray(base)[0, :n] == np.asarray(fast)[0, :n]).all()
