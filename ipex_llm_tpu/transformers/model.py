@@ -282,13 +282,20 @@ class TPUModelForCausalLM:
         gcfg = (generation_config or self.generation_config).with_kwargs(kwargs)
 
         # reference lookup.py:63-83: IPEX_LLM_PERFORMANCE_MODE=1 switches
-        # long greedy prompts to prompt-lookup decoding automatically
+        # long greedy prompts to prompt-lookup decoding automatically.
+        # Pass the MASK-FILTERED row (pad tokens must not enter the ngram
+        # table) and the merged generation config (custom eos/penalties
+        # survive); _spec_generate re-wraps torch outputs itself.
         if (os.environ.get("IPEX_LLM_PERFORMANCE_MODE") == "1"
                 and len(rows) == 1 and len(rows[0]) >= 512
                 and streamer is None and not gcfg.do_sample
                 and self.mesh is None):
-            return self.lookup_generate(
-                input_ids, max_new_tokens=gcfg.max_new_tokens)
+            row = rows[0]
+            if was_torch:
+                import torch
+
+                row = torch.from_numpy(np.ascontiguousarray(row)).long()
+            return self.lookup_generate(row, generation_config=gcfg)
 
         stream_cb = None
         if streamer is not None:

@@ -257,3 +257,45 @@ def test_performance_mode_env_switches_to_lookup(tmp_path, monkeypatch):
     # greedy results agree (lookup is exact for greedy)
     n = min(np.asarray(base).shape[-1], np.asarray(fast).shape[-1])
     assert (np.asarray(base)[0, :n] == np.asarray(fast)[0, :n]).all()
+
+
+def test_performance_mode_respects_mask_and_config(tmp_path, monkeypatch):
+    """The auto-lookup branch must strip pad tokens (attention_mask) and
+    keep the caller's generation config (custom eos)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=160, hidden_size=64, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, tie_word_embeddings=False,
+                      max_position_embeddings=2048)
+    torch.manual_seed(1)
+    path = str(tmp_path / "m")
+    LlamaForCausalLM(cfg).eval().save_pretrained(path,
+                                                 safe_serialization=True)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    core = np.tile(np.arange(16, dtype=np.int32), 40)   # 640 real tokens
+    padded = np.concatenate([np.zeros(8, np.int32), core])[None]
+    mask = np.concatenate([np.zeros(8, np.int32),
+                           np.ones(len(core), np.int32)])[None]
+
+    captured = {}
+    orig = m.lookup_generate
+
+    def spy(ids, *a, **k):
+        captured["n"] = int(np.asarray(_ids(ids)).reshape(-1).shape[0])
+        captured["gcfg"] = k.get("generation_config")
+        return orig(ids, *a, **k)
+
+    def _ids(x):
+        return x.numpy() if hasattr(x, "numpy") else x
+
+    monkeypatch.setattr(m, "lookup_generate", spy)
+    monkeypatch.setenv("IPEX_LLM_PERFORMANCE_MODE", "1")
+    m.generate(padded, attention_mask=mask, max_new_tokens=6,
+               eos_token_id=159)
+    assert captured["n"] == len(core), "pad tokens leaked into lookup"
+    assert captured["gcfg"] is not None
+    assert captured["gcfg"].eos_token_id in (159, (159,), [159])
