@@ -73,15 +73,34 @@ def sample_rows(logits: jnp.ndarray, temps: jnp.ndarray, top_ps: jnp.ndarray,
 
 
 def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
-                              top_ps: jnp.ndarray, key: jax.Array):
+                              top_ps: jnp.ndarray, key: jax.Array,
+                              seeds: jnp.ndarray | None = None,
+                              steps: jnp.ndarray | None = None):
     """sample_rows plus the chosen token's logprob under the MODEL
     distribution (raw log-softmax, the OpenAI ``logprobs`` convention —
-    not the temperature/top-p-modified sampling distribution)."""
+    not the temperature/top-p-modified sampling distribution).
+
+    ``seeds`` [R] int32 (-1 = unseeded) with ``steps`` [R] gives rows a
+    DETERMINISTIC stream — fold_in(PRNGKey(seed), step) — independent of
+    which other requests share the batch; unseeded rows derive per-row
+    keys from the engine's stepping key."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-4)[:, None]
     scaled = _top_p_mask(scaled, top_ps)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    r = logits.shape[0]
+    if seeds is None:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+    else:
+        base = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(r))
+        seeded = jax.vmap(
+            lambda sd, st: jax.random.fold_in(jax.random.PRNGKey(sd), st)
+        )(jnp.maximum(seeds, 0), steps)
+        keys = jnp.where((seeds >= 0)[:, None], seeded, base)
+        sampled = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg)
+        )(keys, scaled)
+    sampled = sampled.astype(jnp.int32)
     chosen = jnp.where(temps > 0, sampled, greedy)
     lp = jnp.take_along_axis(
         jax.nn.log_softmax(logits, axis=-1), chosen[:, None], axis=-1

@@ -83,6 +83,8 @@ class OpenAIServer:
             # relying on the documented default expect sampled output)
             temperature=num("temperature", 1.0, float),
             top_p=num("top_p", 1.0, float),
+            seed=(int(body["seed"]) if body.get("seed") is not None
+                  else None),
             eos_token_id=eos,
             request_id=str(uuid.uuid4()),
         )

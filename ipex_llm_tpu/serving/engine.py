@@ -76,6 +76,7 @@ class Request:
     max_new_tokens: int = 128
     temperature: float = 0.0    # 0 = greedy
     top_p: float = 1.0
+    seed: int | None = None     # deterministic per-request sampling stream
     eos_token_id: tuple[int, ...] = ()
     stream_queue: "queue.Queue[int | None]" = field(default_factory=queue.Queue)
     request_id: str = ""
@@ -163,7 +164,7 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
-                 temps, top_ps, key):
+                 temps, top_ps, key, seeds, steps):
     """One batched decode step over the whole row pool.
 
     toks [R] current token per row; row_lens [R] tokens already in cache.
@@ -176,7 +177,8 @@ def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
         last_token_only=True, slot_offsets=row_lens,
     )
     key, sub = jax.random.split(key)
-    nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub)
+    nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
+                                        seeds=seeds, steps=steps)
     nxt = jnp.where(active, nxt, 0)
     return nxt, lp, cache, key
 
@@ -228,6 +230,7 @@ class ServingEngine:
         self.toks = np.zeros((r,), np.int32)
         self.temps = np.zeros((r,), np.float32)
         self.top_ps = np.ones((r,), np.float32)
+        self.seeds = np.full((r,), -1, np.int32)
         # chunked prefill: rows still consuming their prompt
         self._prefilling: dict[int, np.ndarray] = {}  # row -> remaining ids
         self._row_keys: dict[int, list[bytes]] = {}   # row -> prefix hashes
@@ -357,6 +360,7 @@ class ServingEngine:
             self.row_budget[row] = req.max_new_tokens
             self.temps[row] = req.temperature
             self.top_ps[row] = req.top_p
+            self.seeds[row] = -1 if req.seed is None else int(req.seed)
             self._prefilling[row] = prompt[base:]
             self._row_keys[row] = keys
             self.metrics["requests"] += 1
@@ -408,6 +412,9 @@ class ServingEngine:
         first_t, first_lp = sample_rows_with_logprobs(
             logits, jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32), sub,
+            seeds=jnp.asarray([-1 if req.seed is None else int(req.seed)],
+                              jnp.int32),
+            steps=jnp.zeros((1,), jnp.int32),
         )
         first = int(np.asarray(first_t)[0])
         req.first_token_s = time.perf_counter() - req.submitted_s
@@ -497,11 +504,15 @@ class ServingEngine:
         if not active.any():
             return
         cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        steps = np.asarray([
+            len(r.output_ids) if r is not None else 0 for r in self.rows
+        ], np.int32)
         nxt, lps, self.cache, self.key = _decode_step(
             self.cfg, self.params, cache,
             jnp.asarray(self.toks), jnp.asarray(self.row_lens),
             jnp.asarray(active), jnp.asarray(self.temps),
             jnp.asarray(self.top_ps), self.key,
+            jnp.asarray(self.seeds), jnp.asarray(steps),
         )
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)

@@ -50,6 +50,7 @@ class SamplingParams:
     stop: Optional[Sequence[str]] = None
     stop_token_ids: Optional[Sequence[int]] = None
     ignore_eos: bool = False
+    seed: Optional[int] = None
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
 
@@ -92,6 +93,7 @@ def _to_engine_request(prompt_ids, sp: SamplingParams, eos, request_id):
         max_new_tokens=sp.max_tokens,
         temperature=float(sp.temperature),
         top_p=float(sp.top_p),
+        seed=sp.seed,
         eos_token_id=eos_ids,
         stop_strings=list(sp.stop or []),
         request_id=request_id or f"cmpl-{uuid.uuid4().hex[:16]}",

@@ -340,3 +340,30 @@ def test_sixteen_concurrent_streams(cfg_params):
         assert per_tok < 2.0 * solo_per_tok + 0.05, (per_tok, solo_per_tok)
     finally:
         eng.stop()
+
+
+def test_seeded_sampling_reproducible(cfg_params):
+    """Request.seed gives a deterministic stream independent of batch
+    composition; different seeds diverge (OpenAI seed / vLLM seed)."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=128, page_size=32)).start()
+    try:
+        def run(seed, prompt=(3, 5, 7, 9)):
+            req = Request(prompt_ids=list(prompt), max_new_tokens=8,
+                          temperature=1.0, top_p=0.95, seed=seed)
+            eng.submit(req)
+            return tuple(stream_tokens(req))
+
+        a = run(1234)
+        # interleave an unrelated request so batch composition differs
+        other = Request(prompt_ids=[2, 4, 6], max_new_tokens=4,
+                        temperature=1.0)
+        eng.submit(other)
+        b = run(1234)
+        list(stream_tokens(other))
+        assert a == b, (a, b)
+        c = run(4321)
+        assert c != a
+    finally:
+        eng.stop()
