@@ -72,10 +72,21 @@ def sample_rows(logits: jnp.ndarray, temps: jnp.ndarray, top_ps: jnp.ndarray,
     return sample_rows_with_logprobs(logits, temps, top_ps, key)[0]
 
 
+def _top_k_mask_rows(logits: jnp.ndarray, ks: jnp.ndarray) -> jnp.ndarray:
+    """Per-row top-k mask; ks [R] int32, <=0 disables for that row."""
+    v = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    idx = jnp.clip(ks - 1, 0, v - 1)[:, None]
+    cutoff = jnp.take_along_axis(sorted_desc, idx, axis=-1)
+    masked = jnp.where(logits < cutoff, NEG_INF, logits)
+    return jnp.where((ks > 0)[:, None], masked, logits)
+
+
 def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
                               top_ps: jnp.ndarray, key: jax.Array,
                               seeds: jnp.ndarray | None = None,
-                              steps: jnp.ndarray | None = None):
+                              steps: jnp.ndarray | None = None,
+                              top_ks: jnp.ndarray | None = None):
     """sample_rows plus the chosen token's logprob under the MODEL
     distribution (raw log-softmax, the OpenAI ``logprobs`` convention —
     not the temperature/top-p-modified sampling distribution).
@@ -87,6 +98,8 @@ def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-4)[:, None]
+    if top_ks is not None:
+        scaled = _top_k_mask_rows(scaled, top_ks)
     scaled = _top_p_mask(scaled, top_ps)
     r = logits.shape[0]
     if seeds is None:

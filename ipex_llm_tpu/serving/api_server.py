@@ -83,6 +83,7 @@ class OpenAIServer:
             # relying on the documented default expect sampled output)
             temperature=num("temperature", 1.0, float),
             top_p=num("top_p", 1.0, float),
+            top_k=int(body.get("top_k") or 0),
             seed=(int(body["seed"]) if body.get("seed") is not None
                   else None),
             eos_token_id=eos,
@@ -326,7 +327,9 @@ class OpenAIServer:
             "temperature": (p.get("temperature", 1.0)
                             if p.get("do_sample", False) else 0.0),
             "top_p": p.get("top_p", 1.0),
+            "top_k": p.get("top_k", 0),
             "stop": p.get("stop"),
+            "seed": p.get("seed"),
         }
         ids = list(self.tok(body.get("inputs", ""))["input_ids"])
         return self._mk_request(mapped, ids)

@@ -76,6 +76,7 @@ class Request:
     max_new_tokens: int = 128
     temperature: float = 0.0    # 0 = greedy
     top_p: float = 1.0
+    top_k: int = 0              # 0 = off
     seed: int | None = None     # deterministic per-request sampling stream
     eos_token_id: tuple[int, ...] = ()
     stream_queue: "queue.Queue[int | None]" = field(default_factory=queue.Queue)
@@ -164,7 +165,7 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
-                 temps, top_ps, key, seeds, steps):
+                 temps, top_ps, key, seeds, steps, top_ks):
     """One batched decode step over the whole row pool.
 
     toks [R] current token per row; row_lens [R] tokens already in cache.
@@ -178,7 +179,8 @@ def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
     )
     key, sub = jax.random.split(key)
     nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
-                                        seeds=seeds, steps=steps)
+                                        seeds=seeds, steps=steps,
+                                        top_ks=top_ks)
     nxt = jnp.where(active, nxt, 0)
     return nxt, lp, cache, key
 
@@ -231,6 +233,7 @@ class ServingEngine:
         self.temps = np.zeros((r,), np.float32)
         self.top_ps = np.ones((r,), np.float32)
         self.seeds = np.full((r,), -1, np.int32)
+        self.top_ks = np.zeros((r,), np.int32)
         # chunked prefill: rows still consuming their prompt
         self._prefilling: dict[int, np.ndarray] = {}  # row -> remaining ids
         self._row_keys: dict[int, list[bytes]] = {}   # row -> prefix hashes
@@ -361,6 +364,7 @@ class ServingEngine:
             self.temps[row] = req.temperature
             self.top_ps[row] = req.top_p
             self.seeds[row] = -1 if req.seed is None else int(req.seed)
+            self.top_ks[row] = max(0, int(req.top_k or 0))
             self._prefilling[row] = prompt[base:]
             self._row_keys[row] = keys
             self.metrics["requests"] += 1
@@ -415,6 +419,7 @@ class ServingEngine:
             seeds=jnp.asarray([-1 if req.seed is None else int(req.seed)],
                               jnp.int32),
             steps=jnp.zeros((1,), jnp.int32),
+            top_ks=jnp.asarray([max(0, int(req.top_k or 0))], jnp.int32),
         )
         first = int(np.asarray(first_t)[0])
         req.first_token_s = time.perf_counter() - req.submitted_s
@@ -513,6 +518,7 @@ class ServingEngine:
             jnp.asarray(active), jnp.asarray(self.temps),
             jnp.asarray(self.top_ps), self.key,
             jnp.asarray(self.seeds), jnp.asarray(steps),
+            jnp.asarray(self.top_ks),
         )
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)

@@ -106,11 +106,13 @@ class FastChatWorker:
         temperature = float(params.get("temperature", 1.0))
         if not bool(params.get("do_sample", temperature > 0)):
             temperature = 0.0
+        tk = int(params.get("top_k", -1))
         req = Request(
             prompt_ids=list(map(int, ids)),
             max_new_tokens=int(params.get("max_new_tokens", 256)),
             temperature=temperature,
             top_p=float(params.get("top_p", 1.0)),
+            top_k=0 if tk <= 0 else tk,
             eos_token_id=tuple(self._eos) + stop_ids,
             stop_strings=list(stop),
         )

@@ -93,6 +93,7 @@ def _to_engine_request(prompt_ids, sp: SamplingParams, eos, request_id):
         max_new_tokens=sp.max_tokens,
         temperature=float(sp.temperature),
         top_p=float(sp.top_p),
+        top_k=(0 if sp.top_k in (None, -1) else int(sp.top_k)),
         seed=sp.seed,
         eos_token_id=eos_ids,
         stop_strings=list(sp.stop or []),

@@ -367,3 +367,21 @@ def test_seeded_sampling_reproducible(cfg_params):
         assert c != a
     finally:
         eng.stop()
+
+
+def test_top_k_one_is_greedy(cfg_params):
+    """top_k=1 at temperature 1 must reproduce greedy decoding exactly."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=2, max_seq_len=128, page_size=32)).start()
+    try:
+        greedy = Request(prompt_ids=[3, 5, 7, 9], max_new_tokens=8,
+                         temperature=0.0)
+        eng.submit(greedy)
+        g = tuple(stream_tokens(greedy))
+        k1 = Request(prompt_ids=[3, 5, 7, 9], max_new_tokens=8,
+                     temperature=1.0, top_k=1)
+        eng.submit(k1)
+        assert tuple(stream_tokens(k1)) == g
+    finally:
+        eng.stop()
