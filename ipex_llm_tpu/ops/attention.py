@@ -142,6 +142,19 @@ def sdpa(
     return sdpa_reference(q, k, v, **kwargs)
 
 
+def _decode_kernel_mode(dispatch) -> str | None:
+    """Which decode-kernel variant the active dispatch state allows:
+    'single' (no mesh, Pallas on), 'sharded' (tp mesh with shard_map
+    wrappers), or None (jnp/gather fallback).  One policy for both the
+    paged and dense decode ladders in :func:`cached_sdpa`."""
+    mesh = dispatch.spmd_mesh()
+    if mesh is None:
+        return "single" if dispatch.use_pallas() else None
+    if mesh.shape.get("tp", 1) > 1 and dispatch.use_pallas_sharded():
+        return "sharded"
+    return None
+
+
 def cached_sdpa(
     q: jnp.ndarray,            # [B, T, Hq, D]
     kl: jnp.ndarray,           # [B, Hkv, S, D] raw cache layer (maybe fp8)
@@ -173,20 +186,26 @@ def cached_sdpa(
             and kwargs.get("kv_start") is None   # paged rows start at slot 0
             and kwargs.get("kv_len") is not None
             and q.shape[2] % kl.shape[1] == 0
-            and dispatch.spmd_mesh() is None
-            and dispatch.use_pallas()
         ):
             # decode: read ONLY the row's own pages through the
             # scalar-prefetched block table — no table-width gather
-            try:
-                from ipex_llm_tpu.ops.pallas import paged_attention
+            mode = _decode_kernel_mode(dispatch)
+            if mode is not None:
+                try:
+                    from ipex_llm_tpu.ops.pallas import paged_attention
 
-                return paged_attention.paged_decode_sdpa(
-                    q, kl, vl, cache.tables, kwargs.get("kv_len"),
-                    scale=kwargs.get("scale"),
-                )
-            except (ImportError, NotImplementedError):
-                pass
+                    if mode == "single":
+                        return paged_attention.paged_decode_sdpa(
+                            q, kl, vl, cache.tables, kwargs.get("kv_len"),
+                            scale=kwargs.get("scale"),
+                        )
+                    # TP serving: per-shard kernel over the kv-head split
+                    return paged_attention.paged_decode_sdpa_sharded(
+                        q, kl, vl, cache.tables, kwargs.get("kv_len"),
+                        dispatch.spmd_mesh(), scale=kwargs.get("scale"),
+                    )
+                except (ImportError, NotImplementedError):
+                    pass
         # fallback: gather the rows' pages into the head-major
         # [B, Hkv, S, D] view; tail pages beyond kv_len are garbage and
         # masked exactly like dense-cache slack
@@ -209,24 +228,15 @@ def cached_sdpa(
             window_on=kwargs.get("window_on", True),
             softcap=kwargs.get("softcap"),
         )
-        mesh = dispatch.spmd_mesh()
-        if mesh is None and dispatch.use_pallas():
+        mode = _decode_kernel_mode(dispatch)
+        if mode is not None:
             try:
                 from ipex_llm_tpu.ops.pallas import decode_attention
 
-                return decode_attention.decode_sdpa(q, kl, vl, **dk)
-            except (ImportError, NotImplementedError):
-                pass
-        elif (
-            mesh is not None
-            and mesh.shape.get("tp", 1) > 1
-            and dispatch.use_pallas_sharded()
-        ):
-            try:
-                from ipex_llm_tpu.ops.pallas import decode_attention
-
+                if mode == "single":
+                    return decode_attention.decode_sdpa(q, kl, vl, **dk)
                 return decode_attention.decode_sdpa_sharded(
-                    q, kl, vl, mesh, **dk
+                    q, kl, vl, dispatch.spmd_mesh(), **dk
                 )
             except (ImportError, NotImplementedError):
                 pass

@@ -156,3 +156,34 @@ def paged_decode_sdpa(
     out = _paged(qg, k_pool, v_pool, tables, kv_len,
                  scale=float(scale), out_dtype=q.dtype)
     return out.reshape(r, 1, hq, v_pool.shape[-1])
+
+
+def paged_decode_sdpa_sharded(q, k_pool, v_pool, tables, kv_len, mesh, *,
+                              scale: float | None = None):
+    """Tensor-parallel paged decode: kv heads sharded over ``tp``.
+
+    The pool layer [P, Hkv, ps, D] and q heads split along the head axis
+    (parallel/shard.py::shard_paged_cache / cache_sharding conventions);
+    block tables and lengths are replicated.  Attention is head-local so the
+    per-shard kernel needs no collective — the following row-parallel o-proj
+    psum combines shards, the same contract as decode_sdpa_sharded
+    (reference role: vLLM TP paged-attention workers, SURVEY §2.1 vllm/).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape["tp"]
+    hq, hkv = q.shape[2], k_pool.shape[1]
+    if hq % tp or hkv % tp:
+        raise NotImplementedError("head counts must divide tp")
+
+    def run(ql, kl, vl, tb, ln):
+        return paged_decode_sdpa(ql, kl, vl, tb, ln, scale=scale)
+
+    q_spec = P(None, None, "tp", None)
+    pool_spec = P(None, "tp", None, None)
+    return jax.shard_map(
+        run, mesh=mesh, axis_names={"tp"},
+        in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k_pool, v_pool, tables, kv_len)

@@ -14,10 +14,12 @@ from ipex_llm_tpu.parallel.shard import (
     param_shardings,
     shard_batch,
     shard_cache,
+    shard_paged_cache,
     shard_params,
 )
 
 __all__ = [
     "MeshSpec", "make_mesh", "shard_params", "param_shardings",
     "cache_sharding", "data_sharding", "shard_batch", "shard_cache",
+    "shard_paged_cache",
 ]

@@ -203,6 +203,32 @@ def shard_cache(cache, mesh: Mesh):
     )
 
 
+def shard_paged_cache(cache, mesh: Mesh):
+    """Place a PagedKVCache pool onto the mesh.
+
+    Pool layers ``[L, P, Hkv, page, D]`` shard kv heads over ``tp`` (the
+    same head split cache_sharding uses for dense caches; GQA with fewer kv
+    heads than tp replicates); block tables and lengths are host-driven
+    control state and stay replicated.  This is the serving-side peer of the
+    reference's vLLM TP workers each holding their head slice of the paged
+    pool (SURVEY §2.1 vllm/).
+    """
+    from dataclasses import replace as _replace
+
+    tp = mesh.shape.get("tp", 1)
+    n_kv_heads = cache.k.shape[2]
+    head_axis = "tp" if tp > 1 and _divisible(n_kv_heads, tp) else None
+    pool = NamedSharding(mesh, P(None, None, head_axis, None, None))
+    rep = NamedSharding(mesh, P())
+    return _replace(
+        cache,
+        k=jax.device_put(cache.k, pool),
+        v=jax.device_put(cache.v, pool),
+        tables=jax.device_put(cache.tables, rep),
+        length=jax.device_put(cache.length, rep),
+    )
+
+
 def shard_batch(mesh: Mesh, batch: int, *arrays):
     """Place per-sequence arrays (leading batch axis) onto the dp axis."""
     dp = mesh.shape.get("dp", 1)

@@ -445,17 +445,26 @@ def _read_wav(data: bytes):
 def build_server(model_path: str, low_bit: str = "sym_int4",
                  engine_config: EngineConfig | None = None,
                  model=None, tokenizer=None,
-                 asr_model_path: str | None = None) -> OpenAIServer:
+                 asr_model_path: str | None = None,
+                 tensor_parallel_size: int = 1) -> OpenAIServer:
+    """``tensor_parallel_size`` > 1 serves under a tp mesh (SPMD AutoTP, the
+    reference's vLLM-TP serving mode); a model already ``.shard(mesh)``-ed
+    passes its mesh through implicitly."""
     from ipex_llm_tpu.transformers import AutoModelForCausalLM
 
+    mesh = None
+    if tensor_parallel_size > 1:
+        from ipex_llm_tpu.parallel import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(tp=tensor_parallel_size))
     if model is None:
         import os
 
         if os.path.exists(f"{model_path}/bigdl_config.json"):
-            model = AutoModelForCausalLM.load_low_bit(model_path)
+            model = AutoModelForCausalLM.load_low_bit(model_path, mesh=mesh)
         else:
             model = AutoModelForCausalLM.from_pretrained(
-                model_path, load_in_low_bit=low_bit
+                model_path, load_in_low_bit=low_bit, mesh=mesh
             )
     if tokenizer is None:
         from transformers import AutoTokenizer
@@ -465,6 +474,7 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
     engine = ServingEngine(
         model.config, model.params, engine_config,
         default_eos=model.generation_config.eos_token_id,
+        mesh=mesh if mesh is not None else getattr(model, "mesh", None),
     ).start()
     asr = None
     if asr_model_path is not None:
@@ -493,11 +503,14 @@ def main(argv=None):
     ap.add_argument("--max-seq-len", type=int, default=4096)
     ap.add_argument("--asr-model", default=None,
                     help="whisper checkpoint enabling /v1/audio/transcriptions")
+    ap.add_argument("--tensor-parallel-size", type=int, default=1,
+                    help="serve under a tp mesh of this many chips")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
         EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len),
         asr_model_path=args.asr_model,
+        tensor_parallel_size=args.tensor_parallel_size,
     )
     web.run_app(srv.app, host=args.host, port=args.port)
 

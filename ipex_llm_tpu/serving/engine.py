@@ -163,31 +163,36 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
     return keys
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
 def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
-                 temps, top_ps, key, seeds, steps, top_ks):
+                 temps, top_ps, key, seeds, steps, top_ks, mesh=None):
     """One batched decode step over the whole row pool.
 
     toks [R] current token per row; row_lens [R] tokens already in cache.
+    ``mesh`` (static) marks TP serving: op dispatch then emits
+    shard_map-wrapped kernels, and its presence in the jit key keeps
+    single-device and sharded engines in one process from sharing a trace.
     Returns (next_tokens [R], cache, key).
     """
+    from ipex_llm_tpu.ops import dispatch
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
-    logits, cache = decoder_forward(
-        cfg, params, toks[:, None], cache, row_lens[:, None],
-        last_token_only=True, slot_offsets=row_lens,
-    )
-    key, sub = jax.random.split(key)
-    nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
-                                        seeds=seeds, steps=steps,
-                                        top_ks=top_ks)
-    nxt = jnp.where(active, nxt, 0)
+    with dispatch.spmd(mesh):
+        logits, cache = decoder_forward(
+            cfg, params, toks[:, None], cache, row_lens[:, None],
+            last_token_only=True, slot_offsets=row_lens,
+        )
+        key, sub = jax.random.split(key)
+        nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
+                                            seeds=seeds, steps=steps,
+                                            top_ks=top_ks)
+        nxt = jnp.where(active, nxt, 0)
     return nxt, lp, cache, key
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
 def _prefill_chunk(cfg: ModelConfig, params, cache, tokens, table_row,
-                   base_len, n_valid):
+                   base_len, n_valid, mesh=None):
     """Run one right-padded prompt chunk for a single row.
 
     tokens [1, C]; table_row [1, maxP] (that row's block table); base_len
@@ -196,15 +201,18 @@ def _prefill_chunk(cfg: ModelConfig, params, cache, tokens, table_row,
     in order, and causal masking keeps valid queries from seeing them.
     Returns (last-valid-position logits [1, V], updated cache).
     """
-    row_cache = replace(cache, tables=table_row)
-    pos = base_len + jnp.arange(tokens.shape[1])[None, :]
-    logits, row_cache = decoder_forward(
-        cfg, params, tokens, row_cache, pos,
-        slot_offsets=jnp.reshape(base_len, (1,)),
-    )
-    last = jnp.take_along_axis(
-        logits, (n_valid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1
-    )[:, 0]
+    from ipex_llm_tpu.ops import dispatch
+
+    with dispatch.spmd(mesh):
+        row_cache = replace(cache, tables=table_row)
+        pos = base_len + jnp.arange(tokens.shape[1])[None, :]
+        logits, row_cache = decoder_forward(
+            cfg, params, tokens, row_cache, pos,
+            slot_offsets=jnp.reshape(base_len, (1,)),
+        )
+        last = jnp.take_along_axis(
+            logits, (n_valid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1
+        )[:, 0]
     return last, replace(row_cache, tables=cache.tables)
 
 
@@ -213,17 +221,32 @@ class ServingEngine:
 
     def __init__(self, cfg: ModelConfig, params: dict,
                  engine_config: EngineConfig | None = None,
-                 default_eos: tuple[int, ...] = ()):
+                 default_eos: tuple[int, ...] = (),
+                 mesh=None):
+        """``mesh``: a ``jax.sharding.Mesh`` for TP serving — params are
+        placed under the AutoTP rules and the paged pool's kv heads are
+        sharded, the reference's vLLM-TP-worker serving mode
+        (vllm/xpu/engine/engine.py:40) expressed as SPMD instead of Ray
+        workers.  None = single-chip (the r3 behaviour)."""
         self.cfg = cfg
-        self.params = params
         self.ec = engine_config or EngineConfig()
         self.default_eos = default_eos
+        self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         r = self.ec.max_rows
-        self.cache = PagedKVCache.init(
+        cache = PagedKVCache.init(
             cfg.num_layers, self.ec.n_pages, r, self.ec.max_pages,
             cfg.num_kv_heads, self.ec.page_size, cfg.head_dim,
             v_head_dim=cfg.v_dim,
         )
+        if self.mesh is not None:
+            from ipex_llm_tpu.parallel.shard import (shard_paged_cache,
+                                                     shard_params)
+
+            # re-placing already-sharded params is an idempotent device_put
+            params = shard_params(params, self.mesh)
+            cache = shard_paged_cache(cache, self.mesh)
+        self.params = params
+        self.cache = cache
         self.alloc = PageAllocator(self.ec.n_pages)
         self.tables = np.full((r, self.ec.max_pages), -1, np.int32)
         self.rows: list[Request | None] = [None] * r
@@ -266,6 +289,16 @@ class ServingEngine:
         """Cancel a request (e.g. client disconnect); its row frees at the
         next step boundary."""
         req.cancelled = True
+
+    def _dev_tables(self) -> jnp.ndarray:
+        """Device copy of the host block tables (replicated under a mesh so
+        the step's committed inputs all agree on the device set)."""
+        t = jnp.asarray(self.tables)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            t = jax.device_put(t, NamedSharding(self.mesh, PartitionSpec()))
+        return t
 
     # -- page bookkeeping ----------------------------------------------------
 
@@ -393,11 +426,12 @@ class ServingEngine:
             return
         toks = np.zeros((1, cp), np.int32)
         toks[0, :n_valid] = chunk
-        cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        cache = replace(self.cache, tables=self._dev_tables())
         logits, self.cache = _prefill_chunk(
             self.cfg, self.params, cache, jnp.asarray(toks),
             jnp.asarray(self.tables[row : row + 1]),
             jnp.asarray(base, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+            mesh=self.mesh,
         )
         self.row_lens[row] = base + n_valid
         if n_valid < len(remaining):
@@ -508,7 +542,7 @@ class ServingEngine:
                 active[i] = False
         if not active.any():
             return
-        cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        cache = replace(self.cache, tables=self._dev_tables())
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)
@@ -519,6 +553,7 @@ class ServingEngine:
             jnp.asarray(self.top_ps), self.key,
             jnp.asarray(self.seeds), jnp.asarray(steps),
             jnp.asarray(self.top_ks),
+            mesh=self.mesh,
         )
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)

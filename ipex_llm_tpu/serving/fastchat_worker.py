@@ -49,6 +49,7 @@ class FastChatWorker:
             engine_config or EngineConfig(
                 max_rows=limit_worker_concurrency),
             default_eos=self._eos,
+            mesh=getattr(model, "mesh", None),
         ).start()
         self.app = web.Application()
         self.app.add_routes([

@@ -212,12 +212,18 @@ class TPUModelForCausalLM:
         return model, tokenizer
 
     @classmethod
-    def load_low_bit(cls, path: str, *args, **kwargs):
-        """Reload a ``save_low_bit`` checkpoint (reference model.py:532)."""
+    def load_low_bit(cls, path: str, *args, mesh=None, **kwargs):
+        """Reload a ``save_low_bit`` checkpoint (reference model.py:532).
+
+        ``mesh`` shards the reloaded params under the TP rules, matching the
+        ``from_pretrained(..., mesh=...)`` path."""
         params, hf_config, qtype = serialize.load_low_bit(path)
         family = get_family(hf_config.get("model_type", "llama"))
         cfg = family.to_config(hf_config)
-        return cls(cfg, params, hf_config, qtype)
+        model = cls(cfg, params, hf_config, qtype)
+        if mesh is not None:
+            model.shard(mesh)
+        return model
 
     def save_low_bit(self, path: str) -> None:
         serialize.save_low_bit(path, self.params, self.hf_config, self.qtype)

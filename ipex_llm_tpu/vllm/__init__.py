@@ -109,6 +109,7 @@ class LLM:
                  quantization: str | None = None,
                  trust_remote_code: bool = True, dtype: str = "auto",
                  max_model_len: int = 4096, max_num_seqs: int = 8,
+                 tensor_parallel_size: int = 1,
                  **kwargs: Any):
         from transformers import AutoTokenizer
 
@@ -120,8 +121,16 @@ class LLM:
             load_in_low_bit = {"awq": "asym_int4", "gptq": "sym_int4",
                                "fp8": "fp8"}.get(quantization.lower(),
                                                  quantization)
+        mesh = None
+        if tensor_parallel_size > 1:
+            # vLLM's tensor_parallel_size becomes a tp mesh axis — SPMD
+            # sharding instead of the reference's Ray worker processes
+            # (vllm/xpu/engine/engine.py:40)
+            from ipex_llm_tpu.parallel import MeshSpec, make_mesh
+
+            mesh = make_mesh(MeshSpec(tp=tensor_parallel_size))
         self._model = AutoModelForCausalLM.from_pretrained(
-            model, load_in_low_bit=load_in_low_bit
+            model, load_in_low_bit=load_in_low_bit, mesh=mesh
         )
         self._tok = AutoTokenizer.from_pretrained(
             tokenizer or model, trust_remote_code=trust_remote_code
@@ -132,7 +141,7 @@ class LLM:
         self._engine = ServingEngine(
             self._model.config, self._model.params,
             EngineConfig(max_rows=max_num_seqs, max_seq_len=max_model_len),
-            default_eos=self._eos,
+            default_eos=self._eos, mesh=mesh,
         ).start()
 
     def get_tokenizer(self):

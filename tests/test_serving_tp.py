@@ -1,0 +1,185 @@
+"""TP-meshed serving engine correctness (VERDICT r3 missing #1).
+
+The reference serves TP through vLLM Ray workers
+(vllm/xpu/engine/engine.py:40); here the same paged continuous-batching
+engine runs under a tp mesh via SPMD.  Invariants:
+
+- greedy requests through a tp=4 engine produce exactly the single-device
+  engine/generate tokens (no cross-row or cross-shard leakage);
+- under FORCE_PALLAS the shard_map-wrapped paged decode kernel is actually
+  dispatched (not the gather fallback);
+- the OpenAI HTTP surface works end-to-end over a meshed engine.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.generation import GenerationConfig, generate
+from ipex_llm_tpu.parallel import MeshSpec, make_mesh
+from ipex_llm_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+    stream_tokens,
+)
+from tests.test_decoder import rand_params, tiny_cfg
+
+RNG = np.random.default_rng(77)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=131, hidden_size=64, intermediate_size=128,
+                   num_heads=4, num_kv_heads=4, head_dim=16,
+                   max_position_embeddings=512)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+def _reference_tokens(cfg, params, prompt, n):
+    gen = GenerationConfig(max_new_tokens=n, do_sample=False)
+    res = generate(cfg, params, [prompt], gen)
+    return list(res.sequences[0, len(prompt):len(prompt) + n])
+
+
+@pytest.mark.parametrize("spec", [MeshSpec(tp=4), MeshSpec(tp=8)])
+def test_tp_engine_matches_single_device(cfg_params, spec):
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (7, 19, 41)]
+    want = [_reference_tokens(cfg, params, p, 10) for p in prompts]
+
+    mesh = make_mesh(spec)
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=3, max_seq_len=256, prefill_bucket=32),
+        mesh=mesh,
+    ).start()
+    try:
+        reqs = [eng.submit(Request(prompt_ids=p, max_new_tokens=10))
+                for p in prompts]
+        got = [list(stream_tokens(r)) for r in reqs]
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    assert all(r.finish_reason == "length" for r in reqs)
+
+
+def test_tp_engine_paged_kernel_path(cfg_params, monkeypatch):
+    """The sharded paged-attention kernel must actually run under tp (the
+    r3 gap: ops/attention.py disabled the paged kernel under any mesh)."""
+    from ipex_llm_tpu.ops import dispatch
+    from ipex_llm_tpu.ops.pallas import paged_attention as pa
+
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(0, cfg.vocab_size, 12))
+    want = _reference_tokens(cfg, params, prompt, 6)
+
+    monkeypatch.setenv("IPEX_LLM_TPU_FORCE_PALLAS", "1")
+    dispatch.clear_cache()
+    calls = {"n": 0}
+    orig = pa.paged_decode_sdpa_sharded
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(pa, "paged_decode_sdpa_sharded", counting)
+    try:
+        mesh = make_mesh(MeshSpec(tp=4))
+        eng = ServingEngine(
+            cfg, params,
+            EngineConfig(max_rows=2, max_seq_len=256, prefill_bucket=32),
+            mesh=mesh,
+        ).start()
+        try:
+            req = eng.submit(Request(prompt_ids=prompt, max_new_tokens=6))
+            got = list(stream_tokens(req))
+        finally:
+            eng.stop()
+    finally:
+        monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS")
+        dispatch.clear_cache()
+    assert calls["n"] > 0, "sharded paged kernel was never dispatched"
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_engine_prefix_cache_and_reuse(cfg_params):
+    """Prefix caching + row reuse still isolate correctly under the mesh."""
+    cfg, params = cfg_params
+    mesh = make_mesh(MeshSpec(tp=4))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=2, max_seq_len=256, page_size=16,
+                     prefill_bucket=16),
+        mesh=mesh,
+    ).start()
+    try:
+        shared = list(RNG.integers(0, cfg.vocab_size, 40))
+        tails = [list(RNG.integers(0, cfg.vocab_size, 5)) for _ in range(3)]
+        want = [_reference_tokens(cfg, params, shared + t, 6) for t in tails]
+        got = []
+        for t in tails:  # sequential: later ones hit the prefix cache
+            req = eng.submit(Request(prompt_ids=shared + t, max_new_tokens=6))
+            got.append(list(stream_tokens(req)))
+        assert eng.metrics["prefix_hits"] >= 1
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_http_server_over_tp_engine(cfg_params):
+    """OpenAI surface end-to-end on a meshed engine."""
+    pytest.importorskip("aiohttp")
+    import asyncio
+
+    from aiohttp import web
+
+    from ipex_llm_tpu.serving.api_server import OpenAIServer
+    from tests.test_serving import _Tok
+
+    cfg, params = cfg_params
+    mesh = make_mesh(MeshSpec(tp=4))
+    eng = ServingEngine(
+        cfg, params, EngineConfig(max_rows=2, max_seq_len=256,
+                                  prefill_bucket=32),
+        mesh=mesh,
+    ).start()
+    srv = OpenAIServer(eng, _Tok(), "tiny-tp")
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(srv.app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        port_holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(10)
+    try:
+        body = json.dumps({
+            "model": "tiny-tp", "prompt": "1 2 3 4 5", "max_tokens": 6,
+            "temperature": 0,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port_holder['port']}/v1/completions",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            out = json.loads(resp.read())
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+    assert out["choices"][0]["text"]
+    assert out["usage"]["completion_tokens"] == 6
