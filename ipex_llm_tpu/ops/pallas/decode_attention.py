@@ -219,8 +219,18 @@ def decode_sdpa_sharded(q, k_raw, v_raw, mesh, **kwargs):
 
     tp = mesh.shape["tp"]
     hq, hkv = q.shape[2], k_raw.shape[1]
-    if hq % tp or hkv % tp:
-        raise NotImplementedError("head counts must divide tp")
+    if hq % tp:
+        raise NotImplementedError("q heads must divide tp")
+    if hkv % tp:
+        if tp % hkv or (hq // hkv) % (tp // hkv):
+            raise NotImplementedError("unsupported head/tp factorization")
+        # GQA with fewer kv heads than chips (70B north-star: 8 kv heads,
+        # tp=16): repeat kv heads up to tp — repeat-of-replicated feeding a
+        # head-sharded consumer lowers to a local per-shard slice, so each
+        # chip reads only the kv head its q-head group attends to
+        rep = tp // hkv
+        k_raw = jnp.repeat(k_raw, rep, axis=1)
+        v_raw = jnp.repeat(v_raw, rep, axis=1)
 
     def run(ql, kl, vl):
         return decode_sdpa(ql, kl, vl, **kwargs)

@@ -160,21 +160,39 @@ def paged_decode_sdpa(
 
 def paged_decode_sdpa_sharded(q, k_pool, v_pool, tables, kv_len, mesh, *,
                               scale: float | None = None):
-    """Tensor-parallel paged decode: kv heads sharded over ``tp``.
+    """Tensor-parallel paged decode: q heads sharded over ``tp``.
 
-    The pool layer [P, Hkv, ps, D] and q heads split along the head axis
-    (parallel/shard.py::shard_paged_cache / cache_sharding conventions);
-    block tables and lengths are replicated.  Attention is head-local so the
-    per-shard kernel needs no collective — the following row-parallel o-proj
-    psum combines shards, the same contract as decode_sdpa_sharded
+    Two regimes (parallel/shard.py::shard_paged_cache conventions):
+
+    - ``Hkv % tp == 0``: the pool layer [P, Hkv, ps, D] is head-sharded too
+      and each shard's kernel reads only its own kv slice;
+    - ``tp % Hkv == 0`` (GQA with fewer kv heads than chips — the 70B
+      north-star: 8 kv heads on tp=16): kv heads are repeated up to ``tp``
+      before the shard_map; XLA turns repeat-of-replicated + head-sharded
+      consumer into a local slice, so each shard reads the ONE kv head its
+      q-head group attends to.
+
+    Block tables and lengths are replicated.  Attention is head-local so
+    the per-shard kernel needs no collective — the following row-parallel
+    o-proj psum combines shards, the same contract as decode_sdpa_sharded
     (reference role: vLLM TP paged-attention workers, SURVEY §2.1 vllm/).
     """
     from jax.sharding import PartitionSpec as P
 
     tp = mesh.shape["tp"]
     hq, hkv = q.shape[2], k_pool.shape[1]
-    if hq % tp or hkv % tp:
-        raise NotImplementedError("head counts must divide tp")
+    if hq % tp:
+        raise NotImplementedError("q heads must divide tp")
+    if hkv % tp:
+        if tp % hkv or (hq // hkv) % (tp // hkv):
+            raise NotImplementedError("unsupported head/tp factorization")
+        # repeat kv heads up to tp: the source is replicated, the consumer
+        # spec is head-sharded, so XLA lowers this to a LOCAL slice per
+        # shard — no materialized [P, tp, ps, D] array, and per-chip HBM
+        # traffic stays that shard's single kv head
+        rep = tp // hkv
+        k_pool = jnp.repeat(k_pool, rep, axis=1)
+        v_pool = jnp.repeat(v_pool, rep, axis=1)
 
     def run(ql, kl, vl, tb, ln):
         return paged_decode_sdpa(ql, kl, vl, tb, ln, scale=scale)

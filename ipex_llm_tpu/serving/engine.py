@@ -290,16 +290,6 @@ class ServingEngine:
         next step boundary."""
         req.cancelled = True
 
-    def _dev_tables(self) -> jnp.ndarray:
-        """Device copy of the host block tables (replicated under a mesh so
-        the step's committed inputs all agree on the device set)."""
-        t = jnp.asarray(self.tables)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            t = jax.device_put(t, NamedSharding(self.mesh, PartitionSpec()))
-        return t
-
     # -- page bookkeeping ----------------------------------------------------
 
     def _ensure_pages(self, row: int, upto_slot: int) -> bool:
@@ -426,7 +416,8 @@ class ServingEngine:
             return
         toks = np.zeros((1, cp), np.int32)
         toks[0, :n_valid] = chunk
-        cache = replace(self.cache, tables=self._dev_tables())
+        # uncommitted host array: pjit places it per the compiled sharding
+        cache = replace(self.cache, tables=jnp.asarray(self.tables))
         logits, self.cache = _prefill_chunk(
             self.cfg, self.params, cache, jnp.asarray(toks),
             jnp.asarray(self.tables[row : row + 1]),
@@ -542,7 +533,7 @@ class ServingEngine:
                 active[i] = False
         if not active.any():
             return
-        cache = replace(self.cache, tables=self._dev_tables())
+        cache = replace(self.cache, tables=jnp.asarray(self.tables))
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)

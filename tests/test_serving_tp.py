@@ -107,6 +107,55 @@ def test_tp_engine_paged_kernel_path(cfg_params, monkeypatch):
     np.testing.assert_array_equal(got, want)
 
 
+def test_tp_gqa_fewer_kv_heads_than_chips(monkeypatch):
+    """GQA with Hkv < tp (the 70B north-star shape: 8 kv heads on tp=16,
+    scaled down to 2 kv heads on tp=8) must still dispatch the sharded
+    paged kernel — each shard slices its one kv head — and match the
+    single-device tokens exactly."""
+    from ipex_llm_tpu.ops import dispatch
+    from ipex_llm_tpu.ops.pallas import paged_attention as pa
+
+    cfg = tiny_cfg(vocab_size=131, hidden_size=64, intermediate_size=128,
+                   num_heads=8, num_kv_heads=2, head_dim=8,
+                   max_position_embeddings=512)
+    params = rand_params(cfg, qtype="bf16")
+    prompt = list(RNG.integers(0, cfg.vocab_size, 11))
+
+    def engine_tokens(mesh):
+        eng = ServingEngine(
+            cfg, params,
+            EngineConfig(max_rows=2, max_seq_len=256, prefill_bucket=32),
+            mesh=mesh,
+        ).start()
+        try:
+            req = eng.submit(Request(prompt_ids=prompt, max_new_tokens=6))
+            return list(stream_tokens(req))
+        finally:
+            eng.stop()
+
+    monkeypatch.setenv("IPEX_LLM_TPU_FORCE_PALLAS", "1")
+    dispatch.clear_cache()
+    calls = {"n": 0}
+    orig = pa.paged_decode_sdpa_sharded
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(pa, "paged_decode_sdpa_sharded", counting)
+    try:
+        # kernel-to-kernel comparison: the jnp path rounds bf16 differently
+        # enough to flip argmax on a random tiny model, so the reference is
+        # the single-device PAGED KERNEL engine, not the jnp generate
+        want = engine_tokens(None)
+        got = engine_tokens(make_mesh(MeshSpec(tp=8)))
+    finally:
+        monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS")
+        dispatch.clear_cache()
+    assert calls["n"] > 0, "sharded paged kernel skipped for GQA hkv<tp"
+    np.testing.assert_array_equal(got, want)
+
+
 def test_tp_engine_prefix_cache_and_reuse(cfg_params):
     """Prefix caching + row reuse still isolate correctly under the mesh."""
     cfg, params = cfg_params
@@ -167,7 +216,7 @@ def test_http_server_over_tp_engine(cfg_params):
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
-    started.wait(10)
+    assert started.wait(10), "HTTP server thread failed to start"
     try:
         body = json.dumps({
             "model": "tiny-tp", "prompt": "1 2 3 4 5", "max_tokens": 6,
