@@ -11,6 +11,12 @@ headline single-GPU model class.
 
 Prints ONE JSON line:
   {"metric": ..., "value": tok_s, "unit": "tok/s", "vs_baseline": ...}
+plus, on real hardware: effective HBM GB/s (decode is bandwidth-bound — the
+roofline currency), a warm-start compile time proving the persistent compile
+cache, and a per-kernel microbench block.
+
+A CPU fallback (tunnel down after bounded retries) stamps ``degraded: true``
+and ``vs_baseline: null`` so a smoke number can never read as a pass.
 
 Baseline: BASELINE.md north-star = 20 decode tok/s/chip (Llama-3-70B INT4 on
 v5e-16, i.e. per-chip parity target for the TP serving config).
@@ -63,7 +69,18 @@ def _build_model(size: str, qtype: str):
     return cfg, params
 
 
-def run(size: str, qtype: str, n_in: int, n_out: int, batch: int):
+def _param_bytes(params) -> int:
+    import jax
+
+    return sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(params)
+        if hasattr(x, "nbytes")
+    )
+
+
+def run(size: str, qtype: str, n_in: int, n_out: int, batch: int,
+        warm_start: bool = False):
+    import jax
     import numpy as np
 
     from ipex_llm_tpu.generation import GenerationConfig, generate
@@ -84,23 +101,41 @@ def run(size: str, qtype: str, n_in: int, n_out: int, batch: int):
     res = generate(cfg, params, prompts, gen)
 
     decode_tok_s = batch / res.rest_token_s if res.rest_token_s > 0 else 0.0
+
+    # effective HBM bandwidth: every decode step reads all packed weights
+    # once plus the live KV (bf16) — the bandwidth-bound decode roofline
+    kv_bytes = (2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
+                * (n_in + n_out / 2) * 2 * batch)
+    eff_gbs = ((_param_bytes(params) + kv_bytes) / res.rest_token_s / 1e9
+               if res.rest_token_s > 0 else 0.0)
+
+    warm_compile_s = None
+    if warm_start:
+        # drop in-memory executables but keep the persistent compile cache:
+        # re-tracing now proves (or disproves) the warm-start story the
+        # cache exists for (r2 measured 124.6 s cold for the 7B program)
+        jax.clear_caches()
+        t0 = time.perf_counter()
+        generate(cfg, params, prompts, gen)
+        warm_compile_s = time.perf_counter() - t0
+
     return {
         "cfg": cfg,
         "build_s": build_s,
         "compile_s": compile_s,
+        "warm_compile_s": warm_compile_s,
         "ttft_s": res.first_token_s,
         "decode_tok_s": decode_tok_s,
+        "eff_hbm_gbs": eff_gbs,
     }
 
 
-def _tpu_reachable(timeout_s: float = 120.0) -> bool:
+def _probe_once(timeout_s: float) -> bool:
     """Probe backend init in a SUBPROCESS: a wedged axon tunnel hangs
     ``jax.devices()`` forever (it cannot be interrupted in-process), which
     would otherwise eat the whole bench budget without printing anything."""
     import subprocess
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return False
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -112,12 +147,30 @@ def _tpu_reachable(timeout_s: float = 120.0) -> bool:
         return False
 
 
+def _tpu_reachable(attempts: int = 3, timeout_s: float = 120.0,
+                   wait_s: float = 60.0) -> bool:
+    """Bounded retry: the tunnel has been observed to come back after short
+    blips — wait out up to ``attempts`` probes before surrendering to the
+    degraded CPU record (VERDICT r3 weak #1)."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    for i in range(attempts):
+        if _probe_once(timeout_s):
+            return True
+        print(f"bench: TPU probe {i + 1}/{attempts} failed", file=sys.stderr)
+        if i + 1 < attempts:
+            time.sleep(wait_s)
+    return False
+
+
 def main():
+    degraded = False
     if not _tpu_reachable():
         # honest degraded record: the chip/tunnel is down, run the tiny CPU
         # smoke config so the driver gets a parseable line instead of a hang
         print("bench: TPU backend unreachable, falling back to CPU smoke "
               "config", file=sys.stderr)
+        degraded = True
         import jax
 
         # env var is too late here — the axon sitecustomize registered the
@@ -135,7 +188,7 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "1"))
 
     try:
-        r = run(size, qtype, n_in, n_out, batch)
+        r = run(size, qtype, n_in, n_out, batch, warm_start=on_tpu)
     except Exception as e:  # Pallas path failed on this backend: XLA fallback
         print(f"bench: retrying with Pallas disabled ({type(e).__name__}: {e})",
               file=sys.stderr)
@@ -143,18 +196,37 @@ def main():
         from ipex_llm_tpu.ops import dispatch
 
         dispatch.clear_cache()
-        r = run(size, qtype, n_in, n_out, batch)
+        r = run(size, qtype, n_in, n_out, batch, warm_start=on_tpu)
+
+    micro = []
+    if on_tpu and os.environ.get("BENCH_MICRO", "1") == "1":
+        try:
+            from benchmark.microbench import collect
+
+            micro = collect(iters=20)
+        except Exception as e:  # noqa: BLE001 — the headline number stands
+            print(f"bench: microbench failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
 
     baseline = 20.0  # BASELINE.md: >=20 decode tok/s/chip north-star
-    print(json.dumps({
+    line = {
         "metric": f"llama_{size}_{qtype}_decode_tok_s_{n_in}in_{n_out}out_b{batch}",
         "value": round(r["decode_tok_s"], 3),
         "unit": "tok/s",
-        "vs_baseline": round(r["decode_tok_s"] / baseline, 3),
+        # a degraded (CPU tiny-model) number must never read as a pass
+        "vs_baseline": None if degraded or not on_tpu
+        else round(r["decode_tok_s"] / baseline, 3),
         "ttft_s": round(r["ttft_s"], 4),
         "compile_s": round(r["compile_s"], 1),
         "backend": backend,
-    }))
+        "degraded": degraded or not on_tpu,
+        "eff_hbm_gbs": round(r["eff_hbm_gbs"], 1),
+    }
+    if r["warm_compile_s"] is not None:
+        line["warm_compile_s"] = round(r["warm_compile_s"], 1)
+    if micro:
+        line["microbench"] = micro
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -3,6 +3,10 @@
 Times the decode-path hot ops in isolation (fused dequant-matmul at M=1,
 decode attention) against their XLA fallbacks, reporting effective HBM
 bandwidth — the decode roofline currency.  Run: python benchmark/microbench.py
+
+``collect()`` returns the same numbers structured, so bench.py can embed a
+per-kernel summary in the driver's BENCH artifact (reference peer: the
+all-in-one harness's per-op CSV columns, dev/benchmark/all-in-one/run.py).
 """
 
 from __future__ import annotations
@@ -34,7 +38,7 @@ def timeit(fn, *args, iters=50):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_qmatmul(m, k, n, qtype="sym_int4"):
+def bench_qmatmul(m, k, n, qtype="sym_int4", iters=50):
     rng = np.random.default_rng(0)
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
@@ -52,14 +56,18 @@ def bench_qmatmul(m, k, n, qtype="sym_int4"):
     bytes_w = qt.nbytes + m * k * 2 + m * n * 4
     f_pallas = jax.jit(lambda x: qmatmul_pallas(x, qt))
     f_ref = jax.jit(lambda x: qmatmul_reference(x, qt))
-    tp = timeit(f_pallas, x)
-    tr = timeit(f_ref, x)
+    tp = timeit(f_pallas, x, iters=iters)
+    tr = timeit(f_ref, x, iters=iters)
     print(f"qmatmul {qtype} M={m} [{k}x{n}]: pallas {tp*1e6:8.1f}us "
           f"({bytes_w/tp/1e9:6.1f} GB/s) | xla {tr*1e6:8.1f}us "
           f"({bytes_w/tr/1e9:6.1f} GB/s)")
+    return {"op": f"qmatmul_{qtype}_m{m}_{k}x{n}",
+            "pallas_us": round(tp * 1e6, 1), "xla_us": round(tr * 1e6, 1),
+            "pallas_gbs": round(bytes_w / tp / 1e9, 1),
+            "xla_gbs": round(bytes_w / tr / 1e9, 1)}
 
 
-def bench_decode_attn(b, hq, hkv, s, d, dtype=jnp.bfloat16):
+def bench_decode_attn(b, hq, hkv, s, d, dtype=jnp.bfloat16, iters=50):
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((b, 1, hq, d)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32).astype(dtype)
@@ -77,11 +85,36 @@ def bench_decode_attn(b, hq, hkv, s, d, dtype=jnp.bfloat16):
         return sdpa_reference(q, kd, vd, causal=True, q_positions=qpos,
                               kv_len=kv_len, kv_start=kv_start)
     f_ref = jax.jit(ref)
-    tk = timeit(f_kern, q, k, v)
-    tr = timeit(f_ref, q, k, v)
+    tk = timeit(f_kern, q, k, v, iters=iters)
+    tr = timeit(f_ref, q, k, v, iters=iters)
     print(f"decode_attn B={b} Hq={hq} Hkv={hkv} S={s} D={d} {k.dtype}: "
           f"kernel {tk*1e6:8.1f}us ({nbytes/tk/1e9:6.1f} GB/s) | "
           f"xla {tr*1e6:8.1f}us ({nbytes/tr/1e9:6.1f} GB/s)")
+    return {"op": f"decode_attn_b{b}_h{hq}/{hkv}_s{s}_d{d}_{k.dtype.name}",
+            "pallas_us": round(tk * 1e6, 1), "xla_us": round(tr * 1e6, 1),
+            "pallas_gbs": round(nbytes / tk / 1e9, 1),
+            "xla_gbs": round(nbytes / tr / 1e9, 1)}
+
+
+def collect(iters: int = 20) -> list[dict]:
+    """Compact per-kernel summary for the BENCH artifact (fail-soft: an op
+    whose kernel path is ineligible on this backend is skipped)."""
+    out = []
+    jobs = [
+        (bench_qmatmul, (1, 4096, 12288), {"iters": iters}),   # merged qkv
+        (bench_qmatmul, (1, 11008, 4096), {"iters": iters}),   # down
+        (bench_qmatmul, (1, 4096, 32000), {"iters": iters}),   # lm head
+        (bench_decode_attn, (1, 32, 32, 1280, 128), {"iters": iters}),
+        (bench_decode_attn, (1, 32, 8, 4096, 128),
+         {"dtype": jnp.float8_e5m2, "iters": iters}),          # fp8 KV
+    ]
+    for fn, args, kw in jobs:
+        try:
+            out.append(fn(*args, **kw))
+        except Exception as e:  # noqa: BLE001 — record, keep benching
+            print(f"microbench skip {fn.__name__}{args}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    return out
 
 
 if __name__ == "__main__":

@@ -1,0 +1,215 @@
+"""Perplexity + KV-policy accuracy harness.
+
+Reference counterparts:
+- ``dev/benchmark/perplexity/run_wikitext.py:1-123`` — sliding-window
+  wikitext perplexity (seq_len windows advanced by ``stride``, scoring only
+  the fresh tail of each window);
+- ``dev/benchmark/harness/run_llb.py`` — lm-eval wrapper (the adapter class
+  itself lives in ipex_llm_tpu/lmeval.py);
+- ``dev/benchmark/LongBench/config.yaml`` — full_kv vs compress_kv ablation.
+
+All runners are hermetic: with no corpus file they score a deterministic
+built-in text, so CI can gate quantization quality without downloads.
+Low-bit quality is measured as the PPL RATIO vs the same checkpoint's bf16
+oracle — the reference's layer-tolerance tests approximate this indirectly;
+a ratio gate is the end-to-end version.
+
+Usage:
+  python benchmark/ppl.py --model /path/ckpt --qtypes bf16,sym_int4,fp8_e4m3
+  python benchmark/ppl.py --model /path/ckpt --ablation
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# Deterministic fallback corpus (no-download CI): enough distinct clauses
+# that a tiny model's PPL is informative, repeated to corpus length.
+_BUILTIN = (
+    "The quick brown fox jumps over the lazy dog while the river runs "
+    "south past the old mill. Engineers measure perplexity to compare "
+    "language models across quantization formats. A page table maps "
+    "virtual pages onto physical frames, and a KV cache maps positions "
+    "onto attention states. In eighteen hundred and four the expedition "
+    "crossed the divide and followed the water west. Matrix units "
+    "multiply tiles of one hundred twenty eight, so kernels pad their "
+    "operands and mask the slack. "
+)
+
+
+def builtin_tokens(tokenizer=None, n_tokens: int = 4096):
+    """Token ids for the built-in corpus (char-level ids if no tokenizer)."""
+    text = _BUILTIN * (1 + n_tokens // max(len(_BUILTIN) // 4, 1))
+    if tokenizer is None:
+        ids = np.frombuffer(text.encode()[: n_tokens * 4], np.uint8)
+        return ids.astype(np.int32)[:n_tokens] % 256
+    enc = tokenizer(text)["input_ids"]
+    return np.asarray(enc[:n_tokens], np.int32)
+
+
+def _window_nll(cfg, params, window: np.ndarray, score_from: int,
+                kv_kind: str = "normal"):
+    """Sum NLL (nats) + token count over window[score_from:]."""
+    import jax
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.kv import make_cache
+    from ipex_llm_tpu.models.decoder import decoder_forward
+
+    t = len(window)
+
+    @partial(jax.jit, static_argnames=("kind", "tlen"))
+    def run(params, toks, kind, tlen):
+        cache = make_cache(kind, cfg.num_layers, 1, tlen, cfg.num_kv_heads,
+                           cfg.head_dim, v_head_dim=cfg.v_dim)
+        pos = jnp.arange(tlen)[None, :]
+        logits, _ = decoder_forward(cfg, params, toks, cache, pos)
+        lp = jax.nn.log_softmax(logits[0, :-1].astype(jnp.float32), axis=-1)
+        tgt = toks[0, 1:]
+        tok_lp = jnp.take_along_axis(lp, tgt[:, None], axis=1)[:, 0]
+        mask = jnp.arange(tlen - 1) >= (score_from - 1)
+        return -jnp.sum(tok_lp * mask), jnp.sum(mask)
+
+    nll, n = run(params, jnp.asarray(window[None, :], jnp.int32), kv_kind, t)
+    return float(nll), int(n)
+
+
+def sliding_ppl(cfg, params, ids: np.ndarray, *, seq_len: int = 512,
+                stride: int = 256, kv_kind: str = "normal") -> float:
+    """Sliding-window perplexity (reference run_wikitext.py protocol): each
+    window scores only its fresh ``stride`` tail, earlier tokens are
+    context.  Windows are fixed-size so XLA compiles ONE program."""
+    ids = np.asarray(ids, np.int32)
+    seq_len = min(seq_len, len(ids))
+    total_nll, total_n = 0.0, 0
+    prev_end = 0
+    for start in range(0, len(ids) - 1, stride):
+        end = min(start + seq_len, len(ids))
+        if end - start < seq_len:  # keep shapes static: drop the ragged tail
+            break
+        window = ids[start:end]
+        score_from = max(prev_end - start, 1)
+        nll, n = _window_nll(cfg, params, window, score_from, kv_kind)
+        total_nll += nll
+        total_n += n
+        prev_end = end
+    if total_n == 0:  # corpus shorter than one window: single ragged pass
+        nll, n = _window_nll(cfg, params, ids, 1, kv_kind)
+        total_nll, total_n = nll, n
+    return float(np.exp(total_nll / max(total_n, 1)))
+
+
+def compare_qtypes(model_path: str, qtypes: list[str], ids=None,
+                   tokenizer=None, *, seq_len: int = 512,
+                   stride: int = 256) -> dict:
+    """PPL per qtype + ratio vs the bf16 oracle of the SAME checkpoint."""
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    if ids is None:
+        ids = builtin_tokens(tokenizer)
+    out: dict[str, dict] = {}
+    base = None
+    for q in ["bf16"] + [q for q in qtypes if q != "bf16"]:
+        m = AutoModelForCausalLM.from_pretrained(model_path, load_in_low_bit=q)
+        ppl = sliding_ppl(m.config, m.params, ids, seq_len=seq_len,
+                          stride=stride)
+        if q == "bf16":
+            base = ppl
+        out[q] = {"ppl": round(ppl, 4),
+                  "ratio_vs_bf16": round(ppl / base, 4) if base else None}
+        del m
+    return out
+
+
+def kv_ablation(cfg, params, ids=None, *, n_prompt: int = 512,
+                n_new: int = 64) -> dict:
+    """LongBench-style KV-policy ablation: greedy continuations under the
+    full cache vs fp8 KV vs SnapKV compression, reporting token agreement
+    with the full-KV run (reference LongBench/config.yaml full_kv vs
+    compress_kv) and the fp8-KV sliding PPL delta."""
+    from ipex_llm_tpu.generation import GenerationConfig, generate
+
+    if ids is None:
+        ids = builtin_tokens(None, n_tokens=n_prompt + 1)
+    prompt = [list(np.asarray(ids[:n_prompt], np.int32))]
+    gen = GenerationConfig(max_new_tokens=n_new, do_sample=False)
+
+    runs = {}
+    for kind in ("normal", "fp8", "compress"):
+        res = generate(cfg, params, prompt, gen, kv_kind=kind)
+        runs[kind] = np.asarray(res.sequences[0, n_prompt:])
+    full = runs["normal"]
+    out = {"n_prompt": n_prompt, "n_new": n_new}
+    for kind in ("fp8", "compress"):
+        agree = float(np.mean(runs[kind] == full))
+        out[f"{kind}_agreement"] = round(agree, 4)
+    out["fp8_ppl_ratio"] = round(
+        sliding_ppl(cfg, params, ids, kv_kind="fp8")
+        / sliding_ppl(cfg, params, ids, kv_kind="normal"), 4)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("ipex-llm-tpu perplexity harness")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--corpus", default=None,
+                    help="text file; omitted = deterministic builtin corpus")
+    ap.add_argument("--qtypes", default="bf16,sym_int4")
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--stride", type=int, default=256)
+    ap.add_argument("--max-ratio", type=float, default=1.5,
+                    help="fail (exit 1) if any qtype ppl exceeds "
+                         "bf16 * max-ratio")
+    ap.add_argument("--ablation", action="store_true",
+                    help="run the KV-policy ablation instead of qtype sweep")
+    args = ap.parse_args(argv)
+
+    tokenizer = None
+    try:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.model,
+                                                  trust_remote_code=True)
+    except Exception:
+        pass
+    if args.corpus:
+        with open(args.corpus) as f:
+            text = f.read()
+        if tokenizer is None:
+            raise SystemExit("--corpus needs a loadable tokenizer")
+        ids = np.asarray(tokenizer(text)["input_ids"], np.int32)
+    else:
+        ids = builtin_tokens(tokenizer)
+
+    if args.ablation:
+        from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+        m = AutoModelForCausalLM.from_pretrained(args.model,
+                                                 load_in_low_bit="sym_int4")
+        n_prompt = min(512, len(ids) - 1)
+        print(json.dumps({"ablation": kv_ablation(
+            m.config, m.params, ids, n_prompt=n_prompt)}))
+        return 0
+
+    res = compare_qtypes(args.model, args.qtypes.split(","), ids, tokenizer,
+                         seq_len=args.seq_len, stride=args.stride)
+    print(json.dumps({"ppl": res}))
+    bad = [q for q, r in res.items()
+           if r["ratio_vs_bf16"] and r["ratio_vs_bf16"] > args.max_ratio]
+    if bad:
+        print(f"ppl gate FAILED for {bad} (max-ratio {args.max_ratio})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,166 @@
+"""lm-evaluation-harness adapter (reference dev/benchmark/harness/ipexllm.py,
+run_llb.py).
+
+The reference subclasses lm-eval's HFLM around an ipex-llm model; here the
+adapter implements the three-method LM API directly over the TPU model
+object, so it works both registered inside lm-eval (when installed) and
+standalone with duck-typed request objects (anything carrying ``.args``):
+
+    lm = IpexLLMTPULM(pretrained="/path", load_in_low_bit="sym_int4")
+    lm.loglikelihood([Req(("context", "continuation")), ...])
+
+Requests are scored one at a time with right-padded power-of-two buckets so
+XLA compiles a handful of programs, not one per length.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Iterable
+
+import numpy as np
+
+try:  # registered adapter when the harness is installed
+    from lm_eval.api.model import LM as _LMBase
+    from lm_eval.api.registry import register_model as _register
+except Exception:  # standalone: same API, no dependency
+    _LMBase = object
+
+    def _register(*names):
+        def deco(cls):
+            return cls
+        return deco
+
+
+def _args(req) -> tuple:
+    return req.args if hasattr(req, "args") else tuple(req)
+
+
+@_register("ipex-llm-tpu")
+class IpexLLMTPULM(_LMBase):
+    """``lm_eval --model ipex-llm-tpu --model_args pretrained=...,load_in_low_bit=sym_int4``"""
+
+    def __init__(self, pretrained: str | None = None, model=None,
+                 tokenizer=None, load_in_low_bit: str = "sym_int4",
+                 max_length: int = 2048, max_gen_toks: int = 256,
+                 batch_size: int = 1, device: str = "tpu", **kwargs: Any):
+        if _LMBase is not object:
+            super().__init__()
+        if model is None:
+            from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+            model = AutoModelForCausalLM.from_pretrained(
+                pretrained, load_in_low_bit=load_in_low_bit, **kwargs)
+        self.model = model
+        if tokenizer is None and pretrained is not None:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(pretrained,
+                                                      trust_remote_code=True)
+        self.tok = tokenizer
+        self.max_length = max_length
+        self.max_gen_toks = max_gen_toks
+
+    # -- token scoring ------------------------------------------------------
+
+    def _encode(self, s: str) -> list[int]:
+        return list(self.tok(s)["input_ids"]) if s else []
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    def _forward_logprobs(self, toks: np.ndarray, tlen: int) -> np.ndarray:
+        """log-softmax over a right-padded [1, bucket] window -> [T-1, V]."""
+        import jax
+        import jax.numpy as jnp
+
+        from ipex_llm_tpu.kv import make_cache
+        from ipex_llm_tpu.models.decoder import decoder_forward
+
+        cfg, params = self.model.config, self.model.params
+
+        @partial(jax.jit, static_argnames=("blen",))
+        def run(params, toks, blen):
+            cache = make_cache("normal", cfg.num_layers, 1, blen,
+                               cfg.num_kv_heads, cfg.head_dim,
+                               v_head_dim=cfg.v_dim)
+            pos = jnp.arange(blen)[None, :]
+            logits, _ = decoder_forward(cfg, params, toks, cache, pos)
+            return jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+
+        blen = self._bucket(tlen)
+        pad = np.zeros((1, blen), np.int32)
+        pad[0, :tlen] = toks[:tlen]
+        lp = run(params, pad, blen)
+        return np.asarray(lp)[: tlen - 1]
+
+    def _score(self, ctx_ids: list[int], cont_ids: list[int]):
+        toks = np.asarray(ctx_ids + cont_ids, np.int32)
+        if len(toks) > self.max_length:  # keep the tail (harness convention)
+            drop = len(toks) - self.max_length
+            toks = toks[drop:]
+            ctx_len = max(len(ctx_ids) - drop, 1)
+        else:
+            ctx_len = max(len(ctx_ids), 1)
+        lp = self._forward_logprobs(toks, len(toks))
+        # position i of lp predicts token i+1
+        span = range(ctx_len - 1, len(toks) - 1)
+        ll = float(sum(lp[i, toks[i + 1]] for i in span))
+        greedy = all(int(np.argmax(lp[i])) == int(toks[i + 1]) for i in span)
+        return ll, greedy
+
+    # -- LM API -------------------------------------------------------------
+
+    def loglikelihood(self, requests: Iterable) -> list[tuple[float, bool]]:
+        out = []
+        for req in requests:
+            context, continuation = _args(req)[:2]
+            ctx = self._encode(context)
+            cont = self._encode(continuation)
+            if not cont:  # empty continuation scores 0 by convention
+                out.append((0.0, True))
+                continue
+            if not ctx:
+                ctx = cont[:1]
+                cont = cont[1:]
+                if not cont:
+                    out.append((0.0, True))
+                    continue
+            out.append(self._score(ctx, cont))
+        return out
+
+    def loglikelihood_rolling(self, requests: Iterable) -> list[float]:
+        out = []
+        for req in requests:
+            (text,) = _args(req)[:1]
+            ids = self._encode(text)
+            if len(ids) < 2:
+                out.append(0.0)
+                continue
+            ll, _ = self._score(ids[:1], ids[1:])
+            out.append(ll)
+        return out
+
+    def generate_until(self, requests: Iterable) -> list[str]:
+        from ipex_llm_tpu.generation import GenerationConfig, generate
+
+        out = []
+        for req in requests:
+            context, gen_kwargs = (_args(req) + ({},))[:2]
+            until = list(gen_kwargs.get("until", []) or [])
+            max_new = int(gen_kwargs.get("max_gen_toks", self.max_gen_toks))
+            ids = self._encode(context)[-self.max_length + max_new:]
+            gen = GenerationConfig(max_new_tokens=max_new, do_sample=False)
+            res = generate(self.model.config, self.model.params, [ids], gen)
+            new = list(res.sequences[0, len(ids):])
+            text = self.tok.decode(new)
+            for stop in until:
+                idx = text.find(stop)
+                if idx >= 0:
+                    text = text[:idx]
+            out.append(text)
+        return out

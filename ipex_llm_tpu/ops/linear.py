@@ -27,7 +27,8 @@ def qmatmul_reference(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -
     )
 
 
-_PALLAS_QTYPES = ("sym_int4", "asym_int4", "nf4", "fp4", "sym_int8")
+_PALLAS_QTYPES = ("sym_int4", "asym_int4", "nf4", "fp4", "sym_int8",
+                  "sym_int5", "asym_int5", "fp6", "fp8_e4m3", "fp8_e5m2")
 
 
 def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndarray:

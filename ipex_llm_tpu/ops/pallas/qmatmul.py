@@ -15,9 +15,13 @@ Design (TPU-first, see SURVEY.md §2.3 "TPU mapping"):
 - The contraction (K) axis is the quantization-block axis, so a K tile always
   covers whole quantization blocks and scales slice as ``[BK/bs, BN]``.
 
-Supported formats: sym_int4 / asym_int4 / sym_int8 and the 4-bit codebook
-formats nf4 / fp4 (16-entry lookup unrolled as a select chain on the VPU).
-Anything else falls back to the XLA reference path in ops/linear.py.
+Supported formats: sym_int4 / asym_int4 / sym_int8, the 4-bit codebook
+formats nf4 / fp4 (16-entry lookup unrolled as a select chain on the VPU),
+the minifloats fp8_e4m3 / fp8_e5m2 / fp6 (exponent/mantissa decoded
+arithmetically in-kernel — ``exp2`` on the VPU, no 256-entry table), and
+sym/asym_int5 (dual-plane unpack of the _pack_5bit layout: nibble plane +
+bit plane).  Anything else falls back to the XLA reference path in
+ops/linear.py.
 """
 
 from __future__ import annotations
@@ -33,7 +37,23 @@ from jax.experimental.pallas import tpu as pltpu
 from ipex_llm_tpu.quantize import numerics
 from ipex_llm_tpu.quantize.core import QTensor
 
-_SUPPORTED = ("sym_int4", "asym_int4", "sym_int8", "nf4", "fp4")
+_NIB4 = ("sym_int4", "asym_int4", "nf4", "fp4")
+_BIT5 = ("sym_int5", "asym_int5")
+_MINIFLOAT = {  # qtype -> (exp_bits, man_bits, bias)
+    "fp8_e4m3": (4, 3, 7),
+    "fp8_e5m2": (5, 2, 15),
+    "fp6": (3, 2, 3),
+}
+_SUPPORTED = _NIB4 + _BIT5 + ("sym_int8",) + tuple(_MINIFLOAT)
+
+
+def _data_row_factor(qtype: str) -> tuple[int, int]:
+    """(num, den): logical K rows = data rows * num / den."""
+    if qtype in _NIB4:
+        return 2, 1
+    if qtype in _BIT5:
+        return 8, 5
+    return 1, 1
 
 
 def _interpret() -> bool:
@@ -52,44 +72,83 @@ def _codebook_select(codes: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
     return out
 
 
-def _dequant_tile(codes, scales, zeros, qtype: str, bs: int, bk: int, bn: int):
-    """codes [BK(/2), BN] -> w [BK, BN] f32 inside the kernel."""
+def _minifloat_decode(c: jnp.ndarray, exp_bits: int, man_bits: int,
+                      bias: int) -> jnp.ndarray:
+    """Arithmetic 1+e+m minifloat decode (matches numerics._minifloat_table):
+    sign × (1 + m/2^mb) × 2^(e-bias), subnormals m/2^mb × 2^(1-bias)."""
+    man_div = float(1 << man_bits)
+    sign = 1.0 - 2.0 * ((c >> (exp_bits + man_bits)) & 1).astype(jnp.float32)
+    e = ((c >> man_bits) & ((1 << exp_bits) - 1)).astype(jnp.float32)
+    man = (c & ((1 << man_bits) - 1)).astype(jnp.float32)
+    mag = jnp.where(
+        e > 0,
+        (1.0 + man / man_div) * jnp.exp2(e - bias),
+        man / man_div * (2.0 ** (1 - bias)),
+    )
+    return sign * mag
+
+
+def _dequant_tile(codes, scales, zeros, qtype: str, bs: int, bk: int, bn: int,
+                  high=None):
+    """codes [BK(/2), BN] (+ ``high`` [BK/8, BN] for 5-bit) -> w [BK, BN]
+    f32 inside the kernel."""
     nb = bk // bs
     # Mosaic can't lower uint8 bit-ops/casts directly; widen to int32 first
-    if qtype in ("sym_int4", "asym_int4", "nf4", "fp4"):
+    if qtype in _NIB4 or qtype in _BIT5:
         p = codes.reshape(nb, bs // 2, bn).astype(jnp.int32)
         c = jnp.concatenate([p & 0x0F, p >> 4], axis=1)  # [nb, bs, bn]
-    else:  # sym_int8
+        if qtype in _BIT5:  # OR in the fifth-bit plane (core.py::_pack_5bit)
+            hb = high.astype(jnp.int32)  # [bk//8, bn]
+            hi = jnp.stack([(hb >> j) & 1 for j in range(8)], axis=1)
+            c = c | (hi.reshape(nb, bs, bn) << 4)
+    else:  # byte-per-code: sym_int8 / fp8 / fp6
         c = codes.reshape(nb, bs, bn).astype(jnp.int32)
     s = scales.reshape(nb, 1, bn)
     if qtype == "sym_int4":
         w = (c.astype(jnp.float32) - 8.0) * s
+    elif qtype == "sym_int5":
+        w = (c.astype(jnp.float32) - 16.0) * s
     elif qtype == "sym_int8":
         w = (c.astype(jnp.float32) - 128.0) * s
-    elif qtype == "asym_int4":
+    elif qtype in ("asym_int4", "asym_int5"):
         w = c.astype(jnp.float32) * s + zeros.reshape(nb, 1, bn)
     elif qtype == "nf4":
         w = _codebook_select(c, numerics.NF4_TABLE) * s
+    elif qtype in _MINIFLOAT:
+        w = _minifloat_decode(c, *_MINIFLOAT[qtype]) * s
     else:  # fp4
         w = _codebook_select(c, numerics.FP4_TABLE) * s
     return w.reshape(bk, bn)
 
 
-def _kernel(x_ref, d_ref, s_ref, z_ref, o_ref, *, qtype, bs, bk, bn,
-            compute_dtype):
-    ki = pl.program_id(2)
+def _make_kernel(qtype, bs, bk, bn, compute_dtype, has_high, has_zeros):
+    def kern(*refs):
+        x_ref, d_ref = refs[0], refs[1]
+        i = 2
+        h_ref = None
+        if has_high:
+            h_ref, i = refs[i], i + 1
+        s_ref, i = refs[i], i + 1
+        z_ref = refs[i] if has_zeros else None
+        o_ref = refs[-1]
 
-    @pl.when(ki == 0)
-    def _():
-        o_ref[:] = jnp.zeros_like(o_ref)
+        ki = pl.program_id(2)
 
-    w = _dequant_tile(
-        d_ref[:], s_ref[:], None if z_ref is None else z_ref[:],
-        qtype, bs, bk, bn,
-    ).astype(compute_dtype)
-    o_ref[:] += jnp.dot(
-        x_ref[:].astype(compute_dtype), w, preferred_element_type=jnp.float32
-    )
+        @pl.when(ki == 0)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        w = _dequant_tile(
+            d_ref[:], s_ref[:], None if z_ref is None else z_ref[:],
+            qtype, bs, bk, bn,
+            high=None if h_ref is None else h_ref[:],
+        ).astype(compute_dtype)
+        o_ref[:] += jnp.dot(
+            x_ref[:].astype(compute_dtype), w,
+            preferred_element_type=jnp.float32,
+        )
+
+    return kern
 
 
 @functools.partial(
@@ -100,7 +159,8 @@ def _qmatmul_2d(x, data, scales, zeros, *, qtype: str, bs: int,
     """x [M, K_pad] @ dequant(data) [K_pad, N_pad] -> [M, logical_out]."""
     m, k = x.shape
     n = data.shape[1]
-    packed = qtype != "sym_int8"
+    bit5 = qtype in _BIT5
+    num, den = _data_row_factor(qtype)
 
     bm = min(128, _round_up(m, 16))
     bn = min(512, _round_up(n, 128))
@@ -109,13 +169,22 @@ def _qmatmul_2d(x, data, scales, zeros, *, qtype: str, bs: int,
 
     # pad every dim so grid blocks tile exactly (zero scale rows/cols are
     # numerically inert: dequant yields w=0 there for all supported formats
-    # except asym_int4, whose zero-point plane is also zero-padded)
+    # except asym_int4/5, whose zero-point plane is also zero-padded)
     mp, kp, np_ = _round_up(m, bm), _round_up(k, bk), _round_up(n, bn)
     if mp != m or kp != k:
         x = jnp.pad(x, ((0, mp - m), (0, kp - k)))
-    drows = kp // 2 if packed else kp
-    if data.shape[0] != drows or np_ != n:
-        data = jnp.pad(data, ((0, drows - data.shape[0]), (0, np_ - n)))
+    high = None
+    if bit5:
+        # split the _pack_5bit planes: [K/2, N] nibbles ++ [K/8, N] top bits
+        high = data[k // 2:]
+        data = data[: k // 2]
+        if kp != k or np_ != n:
+            data = jnp.pad(data, ((0, (kp - k) // 2), (0, np_ - n)))
+            high = jnp.pad(high, ((0, (kp - k) // 8), (0, np_ - n)))
+    else:
+        drows = kp * den // num
+        if data.shape[0] != drows or np_ != n:
+            data = jnp.pad(data, ((0, drows - data.shape[0]), (0, np_ - n)))
     nb_p = kp // bs
     scales = jnp.pad(
         scales, ((0, nb_p - scales.shape[0]), (0, np_ - n))
@@ -126,21 +195,24 @@ def _qmatmul_2d(x, data, scales, zeros, *, qtype: str, bs: int,
         ).astype(jnp.float32)
 
     grid = (mp // bm, np_ // bn, kp // bk)
-    d_rows = bk // 2 if packed else bk
+    d_rows = bk // 2 if (qtype in _NIB4 or bit5) else bk
+    blk = lambda mi, ni, ki: (ki, ni)  # noqa: E731
     in_specs = [
         pl.BlockSpec((bm, bk), lambda mi, ni, ki: (mi, ki)),
-        pl.BlockSpec((d_rows, bn), lambda mi, ni, ki: (ki, ni)),
-        pl.BlockSpec((bk // bs, bn), lambda mi, ni, ki: (ki, ni)),
+        pl.BlockSpec((d_rows, bn), blk),
     ]
-    args = [x, data, scales]
+    args = [x, data]
+    if bit5:
+        in_specs.append(pl.BlockSpec((bk // 8, bn), blk))
+        args.append(high)
+    in_specs.append(pl.BlockSpec((bk // bs, bn), blk))
+    args.append(scales)
     if zeros is not None:
-        in_specs.append(pl.BlockSpec((bk // bs, bn), lambda mi, ni, ki: (ki, ni)))
+        in_specs.append(pl.BlockSpec((bk // bs, bn), blk))
         args.append(zeros)
 
-    kern = functools.partial(
-        _kernel if zeros is not None else _kernel_nozero,
-        qtype=qtype, bs=bs, bk=bk, bn=bn, compute_dtype=compute_dtype,
-    )
+    kern = _make_kernel(qtype, bs, bk, bn, compute_dtype,
+                        has_high=bit5, has_zeros=zeros is not None)
     out = pl.pallas_call(
         kern,
         grid=grid,
@@ -153,17 +225,13 @@ def _qmatmul_2d(x, data, scales, zeros, *, qtype: str, bs: int,
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * np_ * kp,
             bytes_accessed=(
-                mp * kp * 2 + (kp * np_ // (2 if packed else 1)) + mp * np_ * 4
+                mp * kp * 2 + (kp * np_ * den // num) + mp * np_ * 4
             ),
             transcendentals=0,
         ),
         interpret=_interpret(),
     )(*args)
     return out[:m, :logical_out]
-
-
-def _kernel_nozero(x_ref, d_ref, s_ref, o_ref, **kw):
-    _kernel(x_ref, d_ref, s_ref, None, o_ref, **kw)
 
 
 def qmatmul_pallas(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16,
@@ -177,8 +245,8 @@ def qmatmul_pallas(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16,
         raise NotImplementedError(qt.qtype)
     lead = x.shape[:-1]
     k = x.shape[-1]
-    packed = qt.qtype != "sym_int8"
-    k_pad = qt.data.shape[0] * (2 if packed else 1)
+    num, den = _data_row_factor(qt.qtype)
+    k_pad = qt.data.shape[0] * num // den
     x2 = x.reshape(-1, k)
     if k_pad != k:  # quantization block padding (core.py::_to_blocks)
         x2 = jnp.pad(x2, ((0, 0), (0, k_pad - k)))
@@ -222,7 +290,7 @@ def qmatmul_pallas_sharded(x: jnp.ndarray, qt: QTensor, mesh,
         out_spec = P(*lead, "tp")
     elif qt.tp_mode == "row":
         bs = qt.block_size or 1
-        if qt.in_features % (bs * tp):
+        if qt.in_features % (bs * tp) or qt.qtype in _BIT5:
             raise NotImplementedError("in_features not divisible by bs*tp")
         local_shape = (qt.in_features // tp, qt.out_features)
         w_spec = P("tp", None)

@@ -65,11 +65,14 @@ def _qtensor_spec(qt: QTensor, kind: str, tp: int, stacked: bool,
         return P(*lead, None, "tp"), mode
     if kind == "row" and _divisible(data_in, tp) and _divisible(nb, tp):
         # the kernel's x-shard/data-shard row alignment additionally needs
-        # whole quantization blocks per shard with no padded tail
+        # whole quantization blocks per shard with no padded tail; the
+        # 5-bit dual-plane layout (nibble plane ++ bit plane, _pack_5bit)
+        # has no contiguous per-shard row slice, so it takes the GSPMD path
         bs = qt.block_size or 1
         mode = (
             "row"
             if tp > 1 and bs and qt.in_features % (bs * tp) == 0
+            and qt.qtype not in ("sym_int5", "asym_int5")
             else None
         )
         return P(*lead, "tp", None), mode

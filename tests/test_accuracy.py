@@ -1,0 +1,118 @@
+"""Accuracy harness: perplexity runner, qtype PPL gate, KV ablation, lm-eval
+adapter (VERDICT r3 missing #2; reference dev/benchmark/{perplexity,harness,
+LongBench})."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from benchmark.ppl import (builtin_tokens, compare_qtypes, kv_ablation,
+                           sliding_ppl)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=1024, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg).eval()
+    path = str(tmp_path_factory.mktemp("tiny_llama_acc"))
+    model.save_pretrained(path, safe_serialization=True)
+    return path
+
+
+def test_sliding_ppl_matches_direct_nll(tiny_llama):
+    """One-window sliding PPL must equal the plain full-sequence NLL."""
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(tiny_llama,
+                                             load_in_low_bit="bf16")
+    ids = builtin_tokens(None, n_tokens=128)
+    got = sliding_ppl(m.config, m.params, ids, seq_len=128, stride=128)
+
+    logits = np.asarray(m(ids[None, :]), np.float32)[0]
+    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(
+        -1, keepdims=True)) - logits.max(-1, keepdims=True)
+    nll = -np.mean([lp[i, ids[i + 1]] for i in range(len(ids) - 1)])
+    np.testing.assert_allclose(got, np.exp(nll), rtol=2e-2)
+
+
+def test_qtype_ppl_gate(tiny_llama):
+    """sym_int4 PPL must stay within the reference-expected band of the
+    bf16 oracle (the end-to-end form of the reference's layer-tolerance
+    tests, SURVEY §4)."""
+    res = compare_qtypes(tiny_llama, ["bf16", "sym_int4", "sym_int8"],
+                         ids=builtin_tokens(None, n_tokens=1024),
+                         seq_len=256, stride=128)
+    assert res["bf16"]["ppl"] > 0
+    assert res["sym_int8"]["ratio_vs_bf16"] < 1.05, res
+    assert res["sym_int4"]["ratio_vs_bf16"] < 1.5, res
+
+
+def test_kv_ablation_runs_and_reports(tiny_llama):
+    """fp8-KV and SnapKV ablation: agreement fractions in [0,1], fp8 ppl
+    ratio near 1 (LongBench full_kv vs compress_kv peer)."""
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(tiny_llama,
+                                             load_in_low_bit="bf16")
+    out = kv_ablation(m.config, m.params,
+                      builtin_tokens(None, n_tokens=700),
+                      n_prompt=640, n_new=16)
+    for key in ("fp8_agreement", "compress_agreement"):
+        assert 0.0 <= out[key] <= 1.0
+    assert out["fp8_ppl_ratio"] == pytest.approx(1.0, abs=0.3)
+
+
+class _Req:
+    def __init__(self, *args):
+        self.args = args
+
+
+class _CharTok:
+    def __call__(self, text):
+        return {"input_ids": [ord(c) % 256 for c in text]}
+
+    def decode(self, ids):
+        return "".join(chr(int(i) % 256) for i in ids)
+
+
+def test_lmeval_adapter_loglikelihood(tiny_llama):
+    from ipex_llm_tpu.lmeval import IpexLLMTPULM
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(tiny_llama,
+                                             load_in_low_bit="bf16")
+    lm = IpexLLMTPULM(model=m, tokenizer=_CharTok(), max_length=256)
+    (ll1, greedy1), (ll2, _) = lm.loglikelihood([
+        _Req("the quick brown", " fox"),
+        _Req("the quick brown", " fox"),
+    ])
+    assert ll1 == ll2  # deterministic
+    assert ll1 < 0.0
+    assert isinstance(greedy1, bool)
+    # a longer continuation must not be MORE likely than its own prefix
+    (ll_long, _), = lm.loglikelihood([_Req("the quick brown", " fox jumps")])
+    assert ll_long < ll1
+    # rolling = loglikelihood of all tokens after the first
+    (roll,) = lm.loglikelihood_rolling([_Req("hello world")])
+    assert roll < 0.0
+
+
+def test_lmeval_adapter_generate_until(tiny_llama):
+    from ipex_llm_tpu.lmeval import IpexLLMTPULM
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m = AutoModelForCausalLM.from_pretrained(tiny_llama,
+                                             load_in_low_bit="bf16")
+    lm = IpexLLMTPULM(model=m, tokenizer=_CharTok(), max_length=256,
+                      max_gen_toks=12)
+    outs = lm.generate_until([_Req("abc def", {"max_gen_toks": 12})])
+    assert len(outs) == 1 and isinstance(outs[0], str)
+    assert len(outs[0]) <= 12
