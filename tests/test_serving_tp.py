@@ -232,3 +232,52 @@ def test_http_server_over_tp_engine(cfg_params):
         eng.stop()
     assert out["choices"][0]["text"]
     assert out["usage"]["completion_tokens"] == 6
+
+
+def test_pp_engine_matches_single_device(cfg_params):
+    """Pipelined decode serving (PPModelWorker peer): pp=2 mesh engine with
+    GPipe request groups must match single-device tokens exactly; the
+    engine must actually select the pipelined path."""
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, n))
+               for n in (7, 15, 23, 31)]
+    want = [_reference_tokens(cfg, params, p, 8) for p in prompts]
+
+    mesh = make_mesh(MeshSpec(pp=2))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=4, max_seq_len=256, prefill_bucket=32),
+        mesh=mesh,
+    ).start()
+    try:
+        assert eng._pp_mode, "engine did not select pipelined decode"
+        reqs = [eng.submit(Request(prompt_ids=p, max_new_tokens=8))
+                for p in prompts]
+        got = [list(stream_tokens(r, timeout=300)) for r in reqs]
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_pp_engine_row_churn(cfg_params):
+    """Rows joining/leaving mid-flight under the pipelined step must stay
+    isolated (drain ticks write only the scratch page)."""
+    cfg, params = cfg_params
+    mesh = make_mesh(MeshSpec(pp=2))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=2, max_seq_len=256, prefill_bucket=32),
+        mesh=mesh,
+    ).start()
+    try:
+        prompts = [list(RNG.integers(0, cfg.vocab_size, 6 + 5 * i))
+                   for i in range(5)]
+        want = [_reference_tokens(cfg, params, p, 6) for p in prompts]
+        reqs = [eng.submit(Request(prompt_ids=p, max_new_tokens=6))
+                for p in prompts]
+        got = [list(stream_tokens(r, timeout=300)) for r in reqs]
+    finally:
+        eng.stop()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
