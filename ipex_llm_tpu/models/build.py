@@ -223,18 +223,32 @@ def build_params(
                 lp["router_bias"] = jnp.asarray(
                     get(moe_scheme.score_bias.format(i=i)), jnp.float32
                 )
-            e_gu, e_down = [], []
+            e_gu, e_down, e_ub, e_db = [], [], [], []
             for e in range(cfg.num_experts):
-                gw = get(moe_scheme.e_gate.format(i=i, e=e))
                 uw = get(moe_scheme.e_up.format(i=i, e=e))
                 dw = get(moe_scheme.e_down.format(i=i, e=e))
+                if moe_scheme.e_gate is not None:
+                    gw = get(moe_scheme.e_gate.format(i=i, e=e))
+                    fused = np.concatenate([gw, uw], 0)
+                else:  # non-gated experts (phixtral fc1 -> act -> fc2)
+                    fused = uw
                 e_gu.append(quantize_weight(
-                    np.concatenate([gw, uw], 0), qtype,
+                    fused, qtype,
                     imatrix=_imx(imatrix_data, i, "gate_up", e)))
                 e_down.append(quantize_weight(
                     dw, qtype, imatrix=_imx(imatrix_data, i, "down", e)))
+                ubn = moe_scheme.e_up.format(i=i, e=e)[: -len(".weight")]                     + ".bias"
+                dbn = moe_scheme.e_down.format(i=i, e=e)[: -len(".weight")]                     + ".bias"
+                if has(ubn):
+                    e_ub.append(jnp.asarray(get(ubn), jnp.float32))
+                if has(dbn):
+                    e_db.append(jnp.asarray(get(dbn), jnp.float32))
             lp["moe_gate_up"] = stack_layer_trees(e_gu)
             lp["moe_down"] = stack_layer_trees(e_down)
+            if e_ub:
+                lp["moe_up_bias"] = jnp.stack(e_ub)      # [E, I(2I)]
+            if e_db:
+                lp["moe_down_bias"] = jnp.stack(e_db)    # [E, H]
             if moe_scheme.shared_gate is not None:
                 sg = get(moe_scheme.shared_gate.format(i=i))
                 su = get(moe_scheme.shared_up.format(i=i))

@@ -1,0 +1,452 @@
+"""Conv-augmented attention families: Yuan 2.0 and Baichuan-M1.
+
+Reference counterparts: ``transformers/models/yuan.py`` (localized-filtering
+LF gate — two causal 2-tap convs + layernorm over the hidden stream feeding
+q/k, rolling 2-token state) and ``transformers/models/baichuan_m1.py``
+(depthwise 2-tap causal conv on k/v before rope/cache, rolling 1-token raw
+k/v state); dispatch strings convert.py:934 ("yuan") and :1072
+("baichuan_m1").
+
+Like RWKV (models/rwkv.py), these carry recurrent state beyond the KV cache,
+so they live as self-contained functional decoders over the shared op
+library (rope/sdpa/linear/norms) instead of bending the scan decoder's hot
+path.  Prefill runs the convs as shifted elementwise combines over the full
+sequence (one XLA program, no scan); decode steps carry the tiny rolling
+state explicitly — both shapes static.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.ops import attention as attn_ops
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops import norms as norm_ops
+from ipex_llm_tpu.ops import rope as rope_ops
+from ipex_llm_tpu.quantize import core as qcore
+
+COMPUTE = jnp.bfloat16
+
+
+def _rms(x, w, eps):
+    return norm_ops.rms_norm(x, w, eps)
+
+
+def _rope_tables(inv_freq, positions):
+    """positions [B, T] -> cos/sin [B, T, D/2] (ops/rope.py half layout)."""
+    ang = positions[..., None].astype(jnp.float32) * inv_freq[None, None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _shift1(x, prev):
+    """x [B, T, ...] -> value at t-1 (prev fills t=0); prev [B, 1, ...]."""
+    return jnp.concatenate([prev, x[:, :-1]], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Yuan 2.0
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class YuanConfig:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    norm_eps: float
+    rope_theta: float
+    max_position_embeddings: int
+    eos_token_id: int
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "YuanConfig":
+        h = hf["hidden_size"]
+        n = hf["num_attention_heads"]
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=n,
+            head_dim=h // n,
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            max_position_embeddings=hf.get("max_position_embeddings", 4096),
+            eos_token_id=hf.get("eos_token_id", 77185),
+        )
+
+
+def build_yuan_params(cfg: YuanConfig, get, has, qtype: str) -> dict:
+    def q(name):
+        w = np.ascontiguousarray(get(name).T)  # torch [out,in] -> [in,out]
+        return qcore.quantize(w, qtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        lp = {
+            "attn_norm": jnp.asarray(get(p + "input_layernorm.weight"),
+                                     jnp.float32),
+            "mlp_norm": jnp.asarray(get(p + "post_attention_layernorm.weight"),
+                                    jnp.float32),
+            "q": q(p + "self_attn.q_proj.weight"),
+            "k": q(p + "self_attn.k_proj.weight"),
+            "v": q(p + "self_attn.v_proj.weight"),
+            "o": q(p + "self_attn.o_proj.weight"),
+            # LF gate: conv1 [C1, H, 2, 1], conv2 [H, C1, 2, 1] causal taps
+            "conv1_w": jnp.asarray(
+                get(p + "self_attn.lf_gate.conv1.weight"), jnp.float32),
+            "conv2_w": jnp.asarray(
+                get(p + "self_attn.lf_gate.conv2.weight"), jnp.float32),
+            "lf_norm": jnp.asarray(
+                get(p + "self_attn.lf_gate.output_layernorm.weight"),
+                jnp.float32),
+            "lf_norm_b": jnp.asarray(
+                get(p + "self_attn.lf_gate.output_layernorm.bias"),
+                jnp.float32),
+            "gate": q(p + "mlp.gate_proj.weight"),
+            "up": q(p + "mlp.up_proj.weight"),
+            "down": q(p + "mlp.down_proj.weight"),
+        }
+        for cname in ("conv1", "conv2"):
+            bn = p + f"self_attn.lf_gate.{cname}.bias"
+            if has(bn):
+                lp[cname + "_b"] = jnp.asarray(get(bn), jnp.float32)
+        layers.append(lp)
+    d = cfg.head_dim
+    return {
+        "layers": layers,
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), COMPUTE),
+        "final_norm": jnp.asarray(get("model.norm.weight"), jnp.float32),
+        "lm_head": q("lm_head.weight"),
+        "inv_freq": jnp.asarray(
+            1.0 / (cfg.rope_theta ** (np.arange(0, d, 2) / d)), jnp.float32
+        ),
+    }
+
+
+def _lf_filter(lp, h, prev2):
+    """Localized filtering (reference yuan.py:60-95): two causal 2-tap convs
+    + residual layernorm.  h [B, T, H]; prev2 [B, 2, H] = hidden states at
+    t-2, t-1 (zeros at sequence start).  Returns (lf_out [B, T, H],
+    new_prev2)."""
+    w1 = lp["conv1_w"][:, :, :, 0]            # [C1, H, 2] taps (t-1, t)
+    w2 = lp["conv2_w"][:, :, :, 0]            # [H, C1, 2]
+    hf = h.astype(jnp.float32)
+    hm1 = _shift1(hf, prev2[:, 1:2].astype(jnp.float32))   # h[t-1]
+    hm2 = jnp.concatenate(                                  # h[t-2]
+        [prev2[:, 0:1].astype(jnp.float32), hm1[:, :-1]], axis=1)
+
+    def conv1(prev, cur):
+        c = (jnp.einsum("bth,ch->btc", prev, w1[:, :, 0])
+             + jnp.einsum("bth,ch->btc", cur, w1[:, :, 1]))
+        return c + lp["conv1_b"] if "conv1_b" in lp else c
+
+    c1 = conv1(hm1, hf)        # c1[t]
+    c1m1 = conv1(hm2, hm1)     # c1[t-1]
+    c2 = (jnp.einsum("btc,hc->bth", c1m1, w2[:, :, 0])
+          + jnp.einsum("btc,hc->bth", c1, w2[:, :, 1]))
+    if "conv2_b" in lp:
+        c2 = c2 + lp["conv2_b"]
+    out = norm_ops.layer_norm(c2 + hf, lp["lf_norm"], lp["lf_norm_b"], 1e-5)
+    new_prev2 = jnp.concatenate([prev2[:, 1:], h[:, -1:]], axis=1) \
+        if h.shape[1] == 1 else h[:, -2:]
+    return out.astype(h.dtype), new_prev2
+
+
+def yuan_forward(cfg: YuanConfig, params, tokens, cache, prev2, pos):
+    """tokens [B, T]; prev2 [L, B, 2, H]; pos [B, T] absolute positions.
+    Returns (logits [B, T, V], cache, prev2)."""
+    from ipex_llm_tpu.ops.embedding import embed_lookup
+
+    x = embed_lookup(params["embed"], tokens, COMPUTE)
+    cos, sin = _rope_tables(params["inv_freq"], pos)
+    b, t = tokens.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    kv_len = pos[:, -1] + 1
+    new_k, new_v, new_prev = [], [], []
+    for li, lp in enumerate(params["layers"]):
+        h = _rms(x, lp["attn_norm"], cfg.norm_eps)
+        v = linear_ops.linear(h, lp["v"]).reshape(b, t, nh, hd)
+        lf, np2 = _lf_filter(lp, h, prev2[li])
+        new_prev.append(np2)
+        qh = linear_ops.linear(lf, lp["q"]).reshape(b, t, nh, hd)
+        kh = linear_ops.linear(lf, lp["k"]).reshape(b, t, nh, hd)
+        qh = rope_ops.apply_rope(qh, cos, sin, "half")
+        kh = rope_ops.apply_rope(kh, cos, sin, "half")
+        kl, vl = cache.update_layer(cache.k[li], cache.v[li], kh, v,
+                                    pos[:, 0])
+        new_k.append(kl)
+        new_v.append(vl)
+        attn = attn_ops.cached_sdpa(
+            qh, kl, vl, cache, compute_dtype=COMPUTE, causal=True,
+            q_positions=pos, kv_len=kv_len,
+        ).reshape(b, t, cfg.hidden_size)
+        x = x + linear_ops.linear(attn, lp["o"])
+        hm = _rms(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = linear_ops.linear(hm, lp["gate"])
+        up = linear_ops.linear(hm, lp["up"])
+        x = x + linear_ops.linear(mlp_ops.gated_act_mul(gate, up, "silu"),
+                                  lp["down"])
+    from dataclasses import replace
+
+    cache = replace(cache, k=jnp.stack(new_k), v=jnp.stack(new_v),
+                    length=kv_len[0].astype(jnp.int32))
+    x = _rms(x, params["final_norm"], cfg.norm_eps)
+    logits = linear_ops.linear(x.astype(COMPUTE), params["lm_head"])
+    return logits.astype(jnp.float32), cache, jnp.stack(new_prev)
+
+
+# ---------------------------------------------------------------------------
+# Baichuan-M1
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BaichuanM1Config:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    norm_eps: float
+    rope_theta: float
+    max_position_embeddings: int
+    eos_token_id: int
+    conv_window: int = 2
+
+    @classmethod
+    def from_hf(cls, hf: dict) -> "BaichuanM1Config":
+        h = hf["hidden_size"]
+        n = hf["num_attention_heads"]
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=n,
+            num_kv_heads=hf.get("num_key_value_heads", n),
+            head_dim=hf.get("head_dim", h // n),
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            rope_theta=hf.get("rope_theta", 100000.0),
+            max_position_embeddings=hf.get("max_position_embeddings", 32768),
+            eos_token_id=hf.get("eos_token_id", 2),
+            conv_window=hf.get("conv_window", 2),
+        )
+
+
+def build_baichuan_m1_params(cfg: BaichuanM1Config, get, has,
+                             qtype: str) -> dict:
+    def q(name):
+        return qcore.quantize(np.ascontiguousarray(get(name).T), qtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        lp = {
+            "attn_norm": jnp.asarray(get(p + "input_layernorm.weight"),
+                                     jnp.float32),
+            "mlp_norm": jnp.asarray(get(p + "post_attention_layernorm.weight"),
+                                    jnp.float32),
+            "qkv": q(p + "self_attn.W_pack.weight"),
+            "o": q(p + "self_attn.o_proj.weight"),
+            # depthwise per-kv-head 2-tap kernels [1,1,Hkv,1,2] -> [Hkv, 2]
+            "conv_k": jnp.asarray(get(p + "self_attn.conv_k"),
+                                  jnp.float32).reshape(cfg.num_kv_heads, -1),
+            "conv_v": jnp.asarray(get(p + "self_attn.conv_v"),
+                                  jnp.float32).reshape(cfg.num_kv_heads, -1),
+            "gate": q(p + "mlp.gate_proj.weight"),
+            "up": q(p + "mlp.up_proj.weight"),
+            "down": q(p + "mlp.down_proj.weight"),
+        }
+        layers.append(lp)
+    d = cfg.head_dim
+    return {
+        "layers": layers,
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), COMPUTE),
+        "final_norm": jnp.asarray(get("model.norm.weight"), jnp.float32),
+        "lm_head": q("lm_head.weight"),
+        "inv_freq": jnp.asarray(
+            1.0 / (cfg.rope_theta ** (np.arange(0, d, 2) / d)), jnp.float32
+        ),
+    }
+
+
+def baichuan_m1_forward(cfg: BaichuanM1Config, params, tokens, cache,
+                        last_kv, pos):
+    """tokens [B, T]; last_kv [L, B, 2, Hkv, D] raw k/v at t-1; pos [B, T].
+    The 2-tap depthwise conv (reference baichuan_m1.py:custom_convolution)
+    runs BEFORE rope and caching, so the cache holds convolved+roped k/v
+    and only one raw token of state rolls forward."""
+    from ipex_llm_tpu.ops.embedding import embed_lookup
+
+    x = embed_lookup(params["embed"], tokens, COMPUTE)
+    cos, sin = _rope_tables(params["inv_freq"], pos)
+    b, t = tokens.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    kv_len = pos[:, -1] + 1
+    new_k, new_v, new_last = [], [], []
+    for li, lp in enumerate(params["layers"]):
+        h = _rms(x, lp["attn_norm"], cfg.norm_eps)
+        qkv = linear_ops.linear(h, lp["qkv"])
+        qh = qkv[..., : nh * hd].reshape(b, t, nh, hd)
+        kh = qkv[..., nh * hd: (nh + nkv) * hd].reshape(b, t, nkv, hd)
+        vh = qkv[..., (nh + nkv) * hd:].reshape(b, t, nkv, hd)
+        # causal 2-tap depthwise conv; position 0 of the WHOLE sequence
+        # pads with zero, later chunks pad with the rolled raw state
+        is_start = (pos[:, 0] == 0)[:, None, None, None]
+        prev_k = jnp.where(is_start, 0.0,
+                           last_kv[li, :, 0:1].astype(kh.dtype))
+        prev_v = jnp.where(is_start, 0.0,
+                           last_kv[li, :, 1:2].astype(vh.dtype))
+        ck = lp["conv_k"].astype(jnp.float32)   # [Hkv, 2]
+        cv = lp["conv_v"].astype(jnp.float32)
+        kc = (_shift1(kh, prev_k).astype(jnp.float32) * ck[None, None, :, :1]
+              + kh.astype(jnp.float32) * ck[None, None, :, 1:]).astype(kh.dtype)
+        vc = (_shift1(vh, prev_v).astype(jnp.float32) * cv[None, None, :, :1]
+              + vh.astype(jnp.float32) * cv[None, None, :, 1:]).astype(vh.dtype)
+        new_last.append(jnp.stack([kh[:, -1], vh[:, -1]], axis=1))
+        qh = rope_ops.apply_rope(qh, cos, sin, "half")
+        kc = rope_ops.apply_rope(kc, cos, sin, "half")
+        kl, vl = cache.update_layer(cache.k[li], cache.v[li], kc, vc,
+                                    pos[:, 0])
+        new_k.append(kl)
+        new_v.append(vl)
+        attn = attn_ops.cached_sdpa(
+            qh, kl, vl, cache, compute_dtype=COMPUTE, causal=True,
+            q_positions=pos, kv_len=kv_len,
+        ).reshape(b, t, nh * hd)
+        x = x + linear_ops.linear(attn, lp["o"])
+        hm = _rms(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = linear_ops.linear(hm, lp["gate"])
+        up = linear_ops.linear(hm, lp["up"])
+        x = x + linear_ops.linear(mlp_ops.gated_act_mul(gate, up, "silu"),
+                                  lp["down"])
+    from dataclasses import replace
+
+    cache = replace(cache, k=jnp.stack(new_k), v=jnp.stack(new_v),
+                    length=kv_len[0].astype(jnp.int32))
+    x = _rms(x, params["final_norm"], cfg.norm_eps)
+    logits = linear_ops.linear(x.astype(COMPUTE), params["lm_head"])
+    return logits.astype(jnp.float32), cache, jnp.stack(new_last)
+
+
+# ---------------------------------------------------------------------------
+# drop-in wrappers
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg", "fwd"))
+def _jit_forward(cfg, params, tokens, cache, state, pos, fwd):
+    return fwd(cfg, params, tokens, cache, state, pos)
+
+
+class _ConvAttnBase:
+    """Shared drop-in surface (pattern of models/rwkv.py)."""
+
+    FORWARD = None
+    CONFIG = None
+    BUILD = None
+
+    def __init__(self, cfg, params, hf_config: dict, qtype: str):
+        self.config = cfg
+        self.params = params
+        self.hf_config = hf_config
+        self.qtype = qtype
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.loader import CheckpointReader, read_config
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf = read_config(path)
+        reader = CheckpointReader(path)
+        cfg = cls.CONFIG.from_hf(hf)
+        params = cls.BUILD(cfg, reader.get, reader.has, qtype)
+        return cls(cfg, params, hf, qtype)
+
+    def _state0(self, b: int):
+        raise NotImplementedError
+
+    def _run(self, tokens, cache, state, pos):
+        return _jit_forward(self.config, self.params, tokens, cache, state,
+                            pos, fwd=type(self).FORWARD)
+
+    def __call__(self, input_ids):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, t = ids.shape
+        cfg = self.config
+        cache = KVCache.init(cfg.num_layers, b, t,
+                             getattr(cfg, "num_kv_heads", cfg.num_heads),
+                             cfg.head_dim)
+        pos = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+        logits, _, _ = self._run(jnp.asarray(ids), cache, self._state0(b),
+                                 pos)
+        return logits
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        ids = np.asarray(input_ids, np.int32).reshape(1, -1)
+        b, n_p = ids.shape
+        cfg = self.config
+        cache = KVCache.init(cfg.num_layers, b, n_p + max_new_tokens,
+                             getattr(cfg, "num_kv_heads", cfg.num_heads),
+                             cfg.head_dim)
+        pos = jnp.arange(n_p)[None]
+        logits, cache, state = self._run(jnp.asarray(ids), cache,
+                                         self._state0(b), pos)
+        out = list(ids[0])
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        for step in range(1, max_new_tokens):
+            if tok == cfg.eos_token_id:
+                break
+            pos = jnp.asarray([[n_p + step - 1]], jnp.int32)
+            logits, cache, state = self._run(
+                jnp.asarray([[tok]], jnp.int32), cache, state, pos)
+            tok = int(jnp.argmax(logits[0, -1]))
+            out.append(tok)
+        return np.asarray(out, np.int32)[None]
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(path, self.params, self.hf_config, self.qtype)
+
+
+class TPUYuanForCausalLM(_ConvAttnBase):
+    FORWARD = staticmethod(yuan_forward)
+    CONFIG = YuanConfig
+    BUILD = staticmethod(build_yuan_params)
+    # staticmethod: type(self).FORWARD resolves to the plain function
+
+    def _state0(self, b: int):
+        cfg = self.config
+        return jnp.zeros((cfg.num_layers, b, 2, cfg.hidden_size), COMPUTE)
+
+
+class TPUBaichuanM1ForCausalLM(_ConvAttnBase):
+    FORWARD = staticmethod(baichuan_m1_forward)
+    CONFIG = BaichuanM1Config
+    BUILD = staticmethod(build_baichuan_m1_params)
+
+    def _state0(self, b: int):
+        cfg = self.config
+        return jnp.zeros((cfg.num_layers, b, 2, cfg.num_kv_heads,
+                          cfg.head_dim), COMPUTE)

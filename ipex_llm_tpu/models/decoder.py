@@ -243,25 +243,36 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
 
     if moe_ops.use_sparse():
         out = moe_ops.moe_ffn(
-            h, w, idx, lp["moe_gate_up"], lp["moe_down"], cfg.act, n_e
+            h, w, idx, lp["moe_gate_up"], lp["moe_down"], cfg.act, n_e,
+            gated=cfg.mlp_gated, up_bias=lp.get("moe_up_bias"),
+            down_bias=lp.get("moe_down_bias"),
         ).astype(x.dtype)
     else:
         # dense gate map [B,T,E]: zeros except the top-k columns
         gates = (w[..., None] * jax.nn.one_hot(idx, n_e, dtype=w.dtype)).sum(-2)
 
         def expert_step(acc, xs):
-            e_i, egu, edown = xs
-            gate, up = mlp_ops.split_gate_up(linear_ops.linear(h, egu))
-            y = linear_ops.linear(
-                mlp_ops.gated_act_mul(gate, up, cfg.act), edown
-            )
+            e_i = xs["i"]
+            inner = linear_ops.linear(h, xs["gu"])
+            if "ub" in xs:
+                inner = inner + xs["ub"].astype(inner.dtype)
+            if cfg.mlp_gated:
+                gate, up = mlp_ops.split_gate_up(inner)
+                yi = mlp_ops.gated_act_mul(gate, up, cfg.act)
+            else:
+                yi = mlp_ops.act(inner, cfg.act)
+            y = linear_ops.linear(yi, xs["dn"])
+            if "db" in xs:
+                y = y + xs["db"].astype(y.dtype)
             return acc + y * gates[..., e_i, None].astype(y.dtype), None
 
-        out, _ = jax.lax.scan(
-            expert_step,
-            jnp.zeros_like(x),
-            (jnp.arange(n_e), lp["moe_gate_up"], lp["moe_down"]),
-        )
+        xs = {"i": jnp.arange(n_e), "gu": lp["moe_gate_up"],
+              "dn": lp["moe_down"]}
+        if "moe_up_bias" in lp:
+            xs["ub"] = lp["moe_up_bias"]
+        if "moe_down_bias" in lp:
+            xs["db"] = lp["moe_down_bias"]
+        out, _ = jax.lax.scan(expert_step, jnp.zeros_like(x), xs)
 
     if "shared_gate_up" in lp:  # qwen2-moe shared expert
         gate, up = mlp_ops.split_gate_up(

@@ -388,6 +388,64 @@ def _phi(hf: dict) -> ModelConfig:
     ))
 
 
+def _phixtral(hf: dict) -> ModelConfig:
+    """phixtral (model_type 'phi-msft'): phi-2 blocks (parallel residual off
+    one shared LN, partial rotary, biases) with an MoE of NON-gated
+    fc1->gelu->fc2 experts, softmax-before-topk routing renormalized over
+    the top-k (reference models/phixtral.py:phixtral_moeblock_forward).
+    The msft config spells dimensions n_embd/n_head/n_layer."""
+    n_embd = hf.get("n_embd", hf.get("hidden_size", 2560))
+    n_head = hf.get("n_head", hf.get("num_attention_heads", 32))
+    head_dim = n_embd // n_head
+    hf2 = dict(hf)
+    hf2.setdefault("hidden_size", n_embd)
+    hf2.setdefault("num_attention_heads", n_head)
+    hf2.setdefault("num_hidden_layers", hf.get("n_layer", 32))
+    hf2.setdefault("num_key_value_heads", hf.get("n_head_kv") or n_head)
+    hf2.setdefault("intermediate_size", hf.get("n_inner") or 4 * n_embd)
+    hf2.setdefault("max_position_embeddings", hf.get("n_positions", 2048))
+    hf2.setdefault("partial_rotary_factor",
+                   hf.get("rotary_dim", head_dim) / head_dim)
+    return ModelConfig(**_base_cfg(
+        hf2,
+        norm_kind="layer",
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        act=hf.get("activation_function", "gelu_new"),
+        mlp_gated=False,
+        parallel_blocks=True,
+        attention_bias=True,
+        attention_out_bias=True,
+        num_experts=hf.get("num_local_experts", 4),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        moe_intermediate_size=hf.get("n_inner") or 4 * n_embd,
+        moe_softmax_before_topk=True,
+        moe_norm_topk_prob=True,
+    ))
+
+
+# phixtral checkpoints keep the msft phi-2 module tree (transformer.h.*,
+# mixer.Wqkv packed [q;k;v], lm_head.{ln,linear}); experts live under
+# moe.mlp.{e} with plain fc1/fc2 (reference models/phixtral.py)
+_PHIXTRAL_SCHEME = WeightScheme(
+    embed="transformer.embd.wte.weight",
+    final_norm="lm_head.ln.weight",
+    lm_head="lm_head.linear.weight",
+    attn_norm="transformer.h.{i}.ln.weight",
+    mlp_norm="transformer.h.{i}.ln.weight",
+    q=None, k=None, v=None,
+    qkv="transformer.h.{i}.mixer.Wqkv.{p}",
+    o="transformer.h.{i}.mixer.out_proj.{p}",
+    gate=None, up=None, gate_up=None,
+    down="transformer.h.{i}.moe.mlp.0.fc2.weight",  # unused (MoE layers)
+)
+_PHIXTRAL_MOE = MoEScheme(
+    router="transformer.h.{i}.moe.gate.weight",
+    e_gate=None,
+    e_up="transformer.h.{i}.moe.mlp.{e}.fc1.weight",
+    e_down="transformer.h.{i}.moe.mlp.{e}.fc2.weight",
+)
+
+
 def _gptneox(hf: dict) -> ModelConfig:
     hf2 = dict(hf)
     hf2.setdefault("partial_rotary_factor", hf.get("rotary_pct", 1.0))
@@ -1003,6 +1061,10 @@ FAMILIES: dict[str, Family] = {
         ),
     ),
     "phi": Family("phi", _phi, _PHI_SCHEME),
+    "phi-msft": Family("phi-msft", _phixtral, _PHIXTRAL_SCHEME,
+                       _PHIXTRAL_MOE),
+    "phixtral": Family("phixtral", _phixtral, _PHIXTRAL_SCHEME,
+                       _PHIXTRAL_MOE),
     "gpt_neox": Family("gpt_neox", _gptneox, _GPTNEOX_SCHEME,
                        qkv_transform=_neox_qkv),
     "starcoder2": Family("starcoder2", _starcoder2, _STARCODER2_SCHEME),

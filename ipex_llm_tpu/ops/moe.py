@@ -57,13 +57,26 @@ def _take_expert(qt_or_arr, idx):
     return jax.tree_util.tree_map(lambda x: x[idx], qt_or_arr)
 
 
-def _expert_ffn(x, gate_up, down, act):
-    """x [..., H] through one expert's gated FFN (dequant fused by XLA)."""
-    gate, up = mlp_ops.split_gate_up(linear_ops.linear(x, gate_up))
-    return linear_ops.linear(mlp_ops.gated_act_mul(gate, up, act), down)
+def _expert_ffn(x, gate_up, down, act, gated=True, ub=None, db=None):
+    """x [..., H] through one expert's FFN (dequant fused by XLA): gated
+    SwiGLU (mixtral-style) or fc1 -> act -> fc2 with biases (phixtral,
+    reference phixtral.py:phixtral_mlp_forward)."""
+    inner = linear_ops.linear(x, gate_up)
+    if ub is not None:
+        inner = inner + ub.astype(inner.dtype)
+    if gated:
+        gate, up = mlp_ops.split_gate_up(inner)
+        y = mlp_ops.gated_act_mul(gate, up, act)
+    else:
+        y = mlp_ops.act(inner, act)
+    out = linear_ops.linear(y, down)
+    if db is not None:
+        out = out + db.astype(out.dtype)
+    return out
 
 
-def moe_gather(h, w, idx, gate_up, down, act):
+def moe_gather(h, w, idx, gate_up, down, act, gated=True,
+               up_bias=None, down_bias=None):
     """Per-pair expert gather: h [B,T,H], w/idx [B,T,k].
 
     Weight traffic ∝ number of pairs, not E — the decode-path win.
@@ -76,13 +89,15 @@ def moe_gather(h, w, idx, gate_up, down, act):
     w_f = w.reshape(n * k)
     tok_f = jnp.repeat(jnp.arange(n), k)
 
-    pair_gu = _take_expert(gate_up, idx_f)     # [P, ...] packed planes
-    pair_dn = _take_expert(down, idx_f)
+    # None bias leaves vanish from the pytree, so ONE vmap serves both
+    ew = {"gu": gate_up, "dn": down, "ub": up_bias, "db": down_bias}
+    pair_w = _take_expert(ew, idx_f)           # [P, ...] packed planes
     xi = hf[tok_f]                             # [P, H]
 
     y = jax.vmap(
-        lambda x_, gu_, dn_: _expert_ffn(x_[None], gu_, dn_, act)[0]
-    )(xi, pair_gu, pair_dn)                    # [P, H]
+        lambda x_, pw: _expert_ffn(x_[None], pw["gu"], pw["dn"], act,
+                                   gated, pw.get("ub"), pw.get("db"))[0]
+    )(xi, pair_w)                              # [P, H]
     y = y * w_f[:, None].astype(y.dtype)
     out = jnp.zeros((n, hidden), y.dtype).at[tok_f].add(y)
     return out.reshape(b, t, hidden)
@@ -98,7 +113,8 @@ def _dequant_stack(qt_or_arr):
     return qt_or_arr.astype(jnp.bfloat16)
 
 
-def moe_ragged(h, w, idx, gate_up, down, act, n_experts: int):
+def moe_ragged(h, w, idx, gate_up, down, act, n_experts: int, gated=True,
+               up_bias=None, down_bias=None):
     """Exact sorted dispatch via ``lax.ragged_dot`` (MXU group-gemm).
 
     Tokens sort by expert and run ONE ragged matmul per projection over
@@ -122,24 +138,33 @@ def moe_ragged(h, w, idx, gate_up, down, act, n_experts: int):
     w_s = w_f[order]
     counts = jnp.bincount(e_f, length=n_experts)
 
+    e_s = e_f[order]
     x = hf[tok_s]                                   # [P, H]
     gu = _dequant_stack(gate_up)                    # [E, H, 2I]
     inner = jax.lax.ragged_dot(
         x, gu, counts, preferred_element_type=jnp.float32
     )
-    gate, up = mlp_ops.split_gate_up(inner)
-    act_x = mlp_ops.gated_act_mul(gate, up, act).astype(jnp.bfloat16)
+    if up_bias is not None:
+        inner = inner + up_bias[e_s].astype(inner.dtype)
+    if gated:
+        gate, up = mlp_ops.split_gate_up(inner)
+        act_x = mlp_ops.gated_act_mul(gate, up, act).astype(jnp.bfloat16)
+    else:
+        act_x = mlp_ops.act(inner, act).astype(jnp.bfloat16)
     dn = _dequant_stack(down)                       # [E, I, H]
     y = jax.lax.ragged_dot(
         act_x, dn, counts, preferred_element_type=jnp.float32
     )
+    if down_bias is not None:
+        y = y + down_bias[e_s].astype(y.dtype)
     y = y * w_s[:, None].astype(y.dtype)
     out = jnp.zeros((n, hidden), y.dtype).at[tok_s].add(y)
     return out.reshape(b, t, hidden).astype(h.dtype)
 
 
 def moe_capacity(h, w, idx, gate_up, down, act, n_experts: int,
-                 cf: float | None = None):
+                 cf: float | None = None, gated=True,
+                 up_bias=None, down_bias=None):
     """Capacity-bucketed sort dispatch: h [B,T,H], w/idx [B,T,k]."""
     b, t, hidden = h.shape
     k = idx.shape[-1]
@@ -167,9 +192,11 @@ def moe_capacity(h, w, idx, gate_up, down, act, n_experts: int,
     x_buckets = x_buckets.at[slot].set(hf[tok_sorted])
     x_buckets = x_buckets[:-1].reshape(n_experts, cap, hidden)
 
+    ew = {"gu": gate_up, "dn": down, "ub": up_bias, "db": down_bias}
     y = jax.vmap(
-        lambda xe, gu_, dn_: _expert_ffn(xe, gu_, dn_, act)
-    )(x_buckets, gate_up, down)                # [E, C, H]
+        lambda xe, ew_: _expert_ffn(xe, ew_["gu"], ew_["dn"], act,
+                                    gated, ew_.get("ub"), ew_.get("db"))
+    )(x_buckets, ew)                           # [E, C, H]
 
     y_pairs = y.reshape(n_experts * cap, hidden)[
         jnp.clip(slot, 0, n_experts * cap - 1)
@@ -180,15 +207,17 @@ def moe_capacity(h, w, idx, gate_up, down, act, n_experts: int,
     return out.reshape(b, t, hidden)
 
 
-def moe_ffn(h, w, idx, gate_up, down, act, n_experts: int):
+def moe_ffn(h, w, idx, gate_up, down, act, n_experts: int, gated=True,
+            up_bias=None, down_bias=None):
     """Route by static pair count and mesh: gather (decode), ragged
     group-gemm (exact, single-mesh prefill), capacity buckets (ep)."""
     from ipex_llm_tpu.ops import dispatch
 
+    kw = dict(gated=gated, up_bias=up_bias, down_bias=down_bias)
     n_pairs = h.shape[0] * h.shape[1] * idx.shape[-1]
     if n_pairs <= GATHER_PAIR_LIMIT:
-        return moe_gather(h, w, idx, gate_up, down, act)
+        return moe_gather(h, w, idx, gate_up, down, act, **kw)
     mesh = dispatch.spmd_mesh()
     if mesh is not None and mesh.shape.get("ep", 1) > 1:
-        return moe_capacity(h, w, idx, gate_up, down, act, n_experts)
-    return moe_ragged(h, w, idx, gate_up, down, act, n_experts)
+        return moe_capacity(h, w, idx, gate_up, down, act, n_experts, **kw)
+    return moe_ragged(h, w, idx, gate_up, down, act, n_experts, **kw)

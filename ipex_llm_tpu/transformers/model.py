@@ -121,6 +121,22 @@ class TPUModelForCausalLM:
             return TPURwkvForCausalLM.from_pretrained(
                 path, load_in_low_bit=qtype
             )
+        if hf_config.get("model_type") in ("yuan", "baichuan_m1"):
+            # conv-augmented attention families with rolling state beyond
+            # the KV cache (models/convattn.py; reference models/yuan.py,
+            # models/baichuan_m1.py)
+            from ipex_llm_tpu.models.convattn import (
+                TPUBaichuanM1ForCausalLM,
+                TPUYuanForCausalLM,
+            )
+
+            if mesh is not None:
+                raise NotImplementedError(
+                    "yuan/baichuan_m1 SPMD sharding not supported")
+            cls2 = (TPUYuanForCausalLM
+                    if hf_config["model_type"] == "yuan"
+                    else TPUBaichuanM1ForCausalLM)
+            return cls2.from_pretrained(path, load_in_low_bit=qtype)
         family = get_family(hf_config.get("model_type", "llama"))
         cfg = family.to_config(hf_config)
         reader = CheckpointReader(path)

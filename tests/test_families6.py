@@ -1,0 +1,360 @@
+"""Model-family wave 6 (VERDICT r3 missing #3 tail): phixtral.
+
+phixtral ships only remote code, but its blocks are EXACTLY HF Phi's
+(parallel residual, partial rotary, biases) with the MLP swapped for a
+softmax-before-topk MoE of non-gated fc1->gelu->fc2 experts (reference
+models/phixtral.py).  That gives two mainline-HF oracles:
+
+- identical experts: the renormalized top-k weights sum to 1, so the MoE
+  must equal the single phi MLP -> full-logit parity vs PhiForCausalLM;
+- a router hard-biased to expert j with k=1: phixtral must equal phi whose
+  MLP is expert j -> routing selection checked against the same oracle.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOKENS = np.random.default_rng(6).integers(0, 150, (2, 10)).astype(np.int32)
+
+
+def _save_synthetic(tmp_path, name, config: dict, tensors: dict):
+    import safetensors.numpy
+
+    path = tmp_path / name
+    path.mkdir()
+    safetensors.numpy.save_file(
+        {k: np.ascontiguousarray(v) for k, v in tensors.items()},
+        str(path / "model.safetensors"),
+    )
+    (path / "config.json").write_text(json.dumps(config))
+    return str(path)
+
+
+def _load_logits(path):
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path, load_in_low_bit="bf16")
+    return np.asarray(model(TOKENS), np.float32)
+
+
+def _tiny_phi(seed=0):
+    from transformers import PhiConfig, PhiForCausalLM
+
+    cfg = PhiConfig(
+        vocab_size=150, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=256,
+        layer_norm_eps=1e-5, hidden_act="gelu_new",
+    )
+    torch.manual_seed(seed)
+    model = PhiForCausalLM(cfg).eval()
+    with torch.no_grad():
+        want = model(torch.from_numpy(TOKENS).long()).logits.float().numpy()
+    return cfg, model.state_dict(), want
+
+
+def _phixtral_tensors(cfg, sd, expert_fc, router_rows):
+    """Map an HF phi state dict onto the phixtral (phi-msft) module tree.
+
+    expert_fc: per-expert list of (fc1_w, fc1_b, fc2_w, fc2_b);
+    router_rows: [E, hidden] gate weight.
+    """
+    t = {
+        "transformer.embd.wte.weight": sd["model.embed_tokens.weight"],
+        "lm_head.ln.weight": sd["model.final_layernorm.weight"],
+        "lm_head.ln.bias": sd["model.final_layernorm.bias"],
+        "lm_head.linear.weight": sd["lm_head.weight"],
+        "lm_head.linear.bias": sd["lm_head.bias"],
+    }
+    for i in range(cfg.num_hidden_layers):
+        src = f"model.layers.{i}."
+        dst = f"transformer.h.{i}."
+        t[dst + "ln.weight"] = sd[src + "input_layernorm.weight"]
+        t[dst + "ln.bias"] = sd[src + "input_layernorm.bias"]
+        t[dst + "mixer.Wqkv.weight"] = np.concatenate(
+            [sd[src + "self_attn.q_proj.weight"],
+             sd[src + "self_attn.k_proj.weight"],
+             sd[src + "self_attn.v_proj.weight"]], axis=0)
+        t[dst + "mixer.Wqkv.bias"] = np.concatenate(
+            [sd[src + "self_attn.q_proj.bias"],
+             sd[src + "self_attn.k_proj.bias"],
+             sd[src + "self_attn.v_proj.bias"]], axis=0)
+        t[dst + "mixer.out_proj.weight"] = sd[src + "self_attn.dense.weight"]
+        t[dst + "mixer.out_proj.bias"] = sd[src + "self_attn.dense.bias"]
+        t[dst + "moe.gate.weight"] = router_rows
+        for e, (f1w, f1b, f2w, f2b) in enumerate(expert_fc):
+            t[dst + f"moe.mlp.{e}.fc1.weight"] = f1w(i)
+            t[dst + f"moe.mlp.{e}.fc1.bias"] = f1b(i)
+            t[dst + f"moe.mlp.{e}.fc2.weight"] = f2w(i)
+            t[dst + f"moe.mlp.{e}.fc2.bias"] = f2b(i)
+    return t
+
+
+def _phixtral_config(n_experts, k):
+    return {
+        "model_type": "phi-msft", "vocab_size": 150, "n_embd": 64,
+        "n_head": 4, "n_layer": 2, "n_positions": 256, "rotary_dim": 8,
+        "n_inner": 128, "activation_function": "gelu_new",
+        "layer_norm_epsilon": 1e-5, "num_local_experts": n_experts,
+        "num_experts_per_tok": k,
+    }
+
+
+@pytest.mark.parametrize("dense", [False, True])
+def test_phixtral_identical_experts_match_phi(tmp_path, dense, monkeypatch):
+    """Renormalized top-k over identical experts == the plain phi MLP."""
+    if dense:
+        monkeypatch.setenv("IPEX_LLM_TPU_DENSE_MOE", "1")
+    cfg, sd, want = _tiny_phi()
+    mk = lambda name: (lambda i: sd[f"model.layers.{i}.mlp.{name}"].numpy())
+    experts = [(mk("fc1.weight"), mk("fc1.bias"),
+                mk("fc2.weight"), mk("fc2.bias"))] * 3
+    router = np.random.default_rng(1).standard_normal((3, 64)).astype(
+        np.float32) * 0.1
+    path = _save_synthetic(
+        tmp_path, "phixtral", _phixtral_config(3, 2),
+        _phixtral_tensors(cfg, {k: v.numpy() for k, v in sd.items()},
+                          experts, router))
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+def test_phixtral_routing_selects_expert(tmp_path):
+    """k=1 with an all-zero router: every token ties and top_k picks expert
+    0 (lowest index, both torch and jax); expert 0 is the phi MLP and
+    experts 1/2 are decoys — logits match phi ONLY if the right expert's
+    weights were gathered."""
+    cfg, sd, want = _tiny_phi(seed=2)
+    sdn = {k: v.numpy() for k, v in sd.items()}
+    rng = np.random.default_rng(3)
+
+    def real(name):
+        return lambda i: sdn[f"model.layers.{i}.mlp.{name}"]
+
+    def decoy(name):
+        def get(i):
+            shape = sdn[f"model.layers.{i}.mlp.{name}"].shape
+            return rng.standard_normal(shape).astype(np.float32) * 0.02
+        return get
+
+    real_e = (real("fc1.weight"), real("fc1.bias"),
+              real("fc2.weight"), real("fc2.bias"))
+    decoy_e = (decoy("fc1.weight"), decoy("fc1.bias"),
+               decoy("fc2.weight"), decoy("fc2.bias"))
+    router = np.zeros((3, 64), np.float32)
+    path = _save_synthetic(
+        tmp_path, "phixtral_route", _phixtral_config(3, 1),
+        _phixtral_tensors(cfg, sdn, [real_e, decoy_e, decoy_e], router))
+    got = _load_logits(path)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+# -- yuan / baichuan_m1 (conv-augmented attention, models/convattn.py) -------
+
+
+def _rand_sd_llama_like(rng, h=64, ffn=128, L=2, nh=4, nkv=2, vocab=150):
+    hd = h // nh
+    sd = {"model.embed_tokens.weight":
+          rng.standard_normal((vocab, h)).astype(np.float32) * 0.05,
+          "model.norm.weight": np.ones((h,), np.float32),
+          "lm_head.weight":
+          rng.standard_normal((vocab, h)).astype(np.float32) * 0.05}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.ones((h,), np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones((h,), np.float32)
+        for nm, rows in (("q_proj", nh * hd), ("k_proj", nkv * hd),
+                         ("v_proj", nkv * hd)):
+            sd[p + f"self_attn.{nm}.weight"] = (
+                rng.standard_normal((rows, h)).astype(np.float32) * 0.05)
+        sd[p + "self_attn.o_proj.weight"] = (
+            rng.standard_normal((h, nh * hd)).astype(np.float32) * 0.05)
+        for nm, shape in (("gate_proj", (ffn, h)), ("up_proj", (ffn, h)),
+                          ("down_proj", (h, ffn))):
+            sd[p + f"mlp.{nm}.weight"] = (
+                rng.standard_normal(shape).astype(np.float32) * 0.05)
+    return sd
+
+
+def test_baichuan_m1_identity_conv_matches_llama(tmp_path):
+    """conv taps [0, 1] make the depthwise conv the identity, so
+    baichuan_m1 must reproduce the llama-family logits bit-for-path."""
+    rng = np.random.default_rng(11)
+    sd = _rand_sd_llama_like(rng, nkv=2)
+    llama_cfg = {
+        "model_type": "llama", "vocab_size": 150, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+        "rope_theta": 10000.0, "tie_word_embeddings": False,
+    }
+    lp = _save_synthetic(tmp_path, "llama_ref", llama_cfg, sd)
+    want = _load_logits(lp)
+
+    bsd = dict(sd)
+    for i in range(2):
+        p = f"model.layers.{i}."
+        bsd[p + "self_attn.W_pack.weight"] = np.concatenate(
+            [sd[p + "self_attn.q_proj.weight"],
+             sd[p + "self_attn.k_proj.weight"],
+             sd[p + "self_attn.v_proj.weight"]], axis=0)
+        ident = np.zeros((1, 1, 2, 1, 2), np.float32)
+        ident[..., 1] = 1.0
+        bsd[p + "self_attn.conv_k"] = ident
+        bsd[p + "self_attn.conv_v"] = ident.copy()
+        for nm in ("q_proj", "k_proj", "v_proj"):
+            del bsd[p + f"self_attn.{nm}.weight"]
+    bcfg = dict(llama_cfg, model_type="baichuan_m1")
+    bp = _save_synthetic(tmp_path, "bm1", bcfg, bsd)
+    got = _load_logits(bp)
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.05
+
+
+def _bm1_random_model(rng):
+    from ipex_llm_tpu.models.convattn import (BaichuanM1Config,
+                                              TPUBaichuanM1ForCausalLM,
+                                              build_baichuan_m1_params)
+
+    hf = {"model_type": "baichuan_m1", "vocab_size": 150, "hidden_size": 64,
+          "intermediate_size": 128, "num_hidden_layers": 2,
+          "num_attention_heads": 4, "num_key_value_heads": 2,
+          "rms_norm_eps": 1e-6, "max_position_embeddings": 256,
+          "rope_theta": 10000.0}
+    sd = _rand_sd_llama_like(rng, nkv=2)
+    for i in range(2):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.W_pack.weight"] = np.concatenate(
+            [sd[p + "self_attn.q_proj.weight"],
+             sd[p + "self_attn.k_proj.weight"],
+             sd[p + "self_attn.v_proj.weight"]], axis=0)
+        sd[p + "self_attn.conv_k"] = (
+            rng.standard_normal((1, 1, 2, 1, 2)).astype(np.float32))
+        sd[p + "self_attn.conv_v"] = (
+            rng.standard_normal((1, 1, 2, 1, 2)).astype(np.float32))
+    cfg = BaichuanM1Config.from_hf(hf)
+    params = build_baichuan_m1_params(cfg, lambda n: sd[n],
+                                      lambda n: n in sd, "bf16")
+    return TPUBaichuanM1ForCausalLM(cfg, params, hf, "bf16")
+
+
+def test_baichuan_m1_prefill_matches_stepwise(tmp_path):
+    """Full-sequence logits == chunked prefill + per-token decode: the
+    rolling raw-k/v state crosses chunk/step boundaries exactly."""
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.kv import KVCache
+
+    rng = np.random.default_rng(12)
+    model = _bm1_random_model(rng)
+    cfg = model.config
+    ids = rng.integers(0, 150, (1, 12)).astype(np.int32)
+    full = np.asarray(model(ids), np.float32)
+
+    cache = KVCache.init(cfg.num_layers, 1, 12, cfg.num_kv_heads,
+                         cfg.head_dim)
+    state = model._state0(1)
+    logits7, cache, state = model._run(
+        jnp.asarray(ids[:, :7]), cache, state, jnp.arange(7)[None])
+    np.testing.assert_allclose(np.asarray(logits7), full[:, :7],
+                               rtol=2e-2, atol=2e-2)
+    for tpos in range(7, 12):
+        lg, cache, state = model._run(
+            jnp.asarray(ids[:, tpos:tpos + 1]), cache, state,
+            jnp.asarray([[tpos]], jnp.int32))
+        np.testing.assert_allclose(np.asarray(lg)[0, 0], full[0, tpos],
+                                   rtol=2e-2, atol=2e-2)
+
+
+def _yuan_random_model(rng):
+    from ipex_llm_tpu.models.convattn import (TPUYuanForCausalLM, YuanConfig,
+                                              build_yuan_params)
+
+    hf = {"model_type": "yuan", "vocab_size": 150, "hidden_size": 64,
+          "intermediate_size": 128, "num_hidden_layers": 2,
+          "num_attention_heads": 4, "rms_norm_eps": 1e-6,
+          "max_position_embeddings": 256, "rope_theta": 10000.0}
+    sd = _rand_sd_llama_like(rng, nkv=4)
+    for i in range(2):
+        p = f"model.layers.{i}.self_attn.lf_gate."
+        sd[p + "conv1.weight"] = (
+            rng.standard_normal((32, 64, 2, 1)).astype(np.float32) * 0.1)
+        sd[p + "conv1.bias"] = rng.standard_normal(32).astype(np.float32) * 0.1
+        sd[p + "conv2.weight"] = (
+            rng.standard_normal((64, 32, 2, 1)).astype(np.float32) * 0.1)
+        sd[p + "conv2.bias"] = rng.standard_normal(64).astype(np.float32) * 0.1
+        sd[p + "output_layernorm.weight"] = np.ones((64,), np.float32)
+        sd[p + "output_layernorm.bias"] = np.zeros((64,), np.float32)
+    cfg = YuanConfig.from_hf(hf)
+    params = build_yuan_params(cfg, lambda n: sd[n], lambda n: n in sd,
+                               "bf16")
+    return TPUYuanForCausalLM(cfg, params, hf, "bf16")
+
+
+def test_yuan_lf_filter_matches_literal_loop():
+    """Vectorized LF == the reference decode recurrence replayed per token
+    (yuan.py:80-95: c1[t]=W1·[h[t-1];h[t]], c2[t]=W2·[c1[t-1];c1[t]],
+    LN(c2+h))."""
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.models.convattn import _lf_filter
+
+    rng = np.random.default_rng(13)
+    B, T, H, C1 = 1, 6, 8, 4
+    h = rng.standard_normal((B, T, H)).astype(np.float32)
+    lp = {
+        "conv1_w": jnp.asarray(rng.standard_normal((C1, H, 2, 1)),
+                               jnp.float32),
+        "conv1_b": jnp.asarray(rng.standard_normal(C1), jnp.float32),
+        "conv2_w": jnp.asarray(rng.standard_normal((H, C1, 2, 1)),
+                               jnp.float32),
+        "conv2_b": jnp.asarray(rng.standard_normal(H), jnp.float32),
+        "lf_norm": jnp.ones((H,), jnp.float32),
+        "lf_norm_b": jnp.zeros((H,), jnp.float32),
+    }
+    got, _ = _lf_filter(lp, jnp.asarray(h), jnp.zeros((B, 2, H)))
+
+    w1 = np.asarray(lp["conv1_w"])[:, :, :, 0]
+    w2 = np.asarray(lp["conv2_w"])[:, :, :, 0]
+    b1, b2 = np.asarray(lp["conv1_b"]), np.asarray(lp["conv2_b"])
+    hp = np.concatenate([np.zeros((B, 2, H)), h], axis=1)  # pad t-2, t-1
+
+    def c1(t):  # index into hp (offset 2)
+        return w1[:, :, 0] @ hp[0, t + 1] + w1[:, :, 1] @ hp[0, t + 2] + b1
+
+    for t in range(T):
+        c2 = w2[:, :, 0] @ c1(t - 1) + w2[:, :, 1] @ c1(t) + b2
+        y = c2 + h[0, t]
+        y = (y - y.mean()) / np.sqrt(y.var() + 1e-5)
+        np.testing.assert_allclose(np.asarray(got)[0, t], y,
+                                   rtol=3e-2, atol=3e-2)
+
+
+def test_yuan_prefill_matches_stepwise():
+    """The 2-token LF state must roll across chunk/decode boundaries."""
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.kv import KVCache
+
+    rng = np.random.default_rng(14)
+    model = _yuan_random_model(rng)
+    cfg = model.config
+    ids = rng.integers(0, 150, (1, 10)).astype(np.int32)
+    full = np.asarray(model(ids), np.float32)
+
+    cache = KVCache.init(cfg.num_layers, 1, 10, cfg.num_heads, cfg.head_dim)
+    state = model._state0(1)
+    lg, cache, state = model._run(
+        jnp.asarray(ids[:, :6]), cache, state, jnp.arange(6)[None])
+    np.testing.assert_allclose(np.asarray(lg), full[:, :6],
+                               rtol=2e-2, atol=2e-2)
+    for tpos in range(6, 10):
+        lg, cache, state = model._run(
+            jnp.asarray(ids[:, tpos:tpos + 1]), cache, state,
+            jnp.asarray([[tpos]], jnp.int32))
+        np.testing.assert_allclose(np.asarray(lg)[0, 0], full[0, tpos],
+                                   rtol=2e-2, atol=2e-2)
