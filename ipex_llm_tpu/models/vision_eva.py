@@ -1,0 +1,184 @@
+"""EVA2-CLIP vision tower + conv-downsample + GLU projector (GLM-4V).
+
+Reference counterpart: transformers/models/chatglm4v.py (patch_embedding
+:286-297, post-sublayer-norm transformer :263-281, vision_model_forward
+:299-301).  The GLM-4V tower differs from the ViTs in models/vision*.py in
+three ways it is easy to get silently wrong:
+
+- **post-sublayer norms**: the layernorm wraps the sublayer OUTPUT before
+  the residual add (x = x + ln(attn(x))), not the input;
+- after dropping the cls token the patch grid is downsampled by a stride-2
+  Conv2d (run here as a 2x2-patch matmul, the stride==kernel trick);
+- the projector is the CogVLM GLU (linear_proj -> gelu(norm1) ->
+  silu(gate) * h4h -> 4h_to_h) and the output is bracketed by learned
+  ``boi``/``eoi`` embeddings that replace the prompt's placeholder tokens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class EVAVisionConfig:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: int
+    image_size: int
+    norm_eps: float = 1e-6
+    act: str = "gelu"
+    scaling_factor: float = 1.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @classmethod
+    def from_hf(cls, v: dict) -> "EVAVisionConfig":
+        return cls(
+            hidden_size=v["hidden_size"],
+            num_layers=v["num_hidden_layers"],
+            num_heads=v["num_heads"],
+            intermediate_size=v["intermediate_size"],
+            patch_size=v["patch_size"],
+            image_size=v["image_size"],
+            norm_eps=v.get("layer_norm_eps", 1e-6),
+            act=v.get("hidden_act", "gelu"),
+            scaling_factor=v.get("scaling_factor", 1.0),
+        )
+
+
+def build_eva_vision_params(vc: EVAVisionConfig, get, has, qtype: str) -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    vt = "transformer.vision."
+
+    def gb(d, key, n):
+        if has(n):
+            d[key] = jnp.asarray(get(n), jnp.float32)
+
+    p: dict[str, Any] = {}
+    pw = get(vt + "patch_embedding.proj.weight")     # [H, 3, ps, ps]
+    p["patch_proj"] = quantize_weight(
+        np.ascontiguousarray(pw.reshape(pw.shape[0], -1)), qtype)
+    gb(p, "patch_bias", vt + "patch_embedding.proj.bias")
+    p["cls_token"] = jnp.asarray(
+        get(vt + "patch_embedding.cls_embedding"), jnp.float32).reshape(1, -1)
+    p["pos"] = jnp.asarray(
+        get(vt + "patch_embedding.position_embedding.weight"), jnp.float32)
+
+    layers = []
+    for i in range(vc.num_layers):
+        b = f"{vt}transformer.layers.{i}."
+        lp: dict[str, Any] = {}
+        for key, n in (("ln1", "input_layernorm"),
+                       ("ln2", "post_attention_layernorm")):
+            lp[key] = jnp.asarray(get(b + n + ".weight"), jnp.float32)
+            gb(lp, key + "_b", b + n + ".bias")
+        for key, n in (("qkv", "attention.query_key_value"),
+                       ("o", "attention.dense"),
+                       ("fc1", "mlp.fc1"), ("fc2", "mlp.fc2")):
+            lp[key] = quantize_weight(get(b + n + ".weight"), qtype)
+            gb(lp, key + "_b", b + n + ".bias")
+        layers.append(lp)
+    p["blocks"] = stack_layer_trees(layers)
+
+    cw = get(vt + "conv.weight")                     # [H, H, 2, 2]
+    p["conv_proj"] = quantize_weight(
+        np.ascontiguousarray(cw.reshape(cw.shape[0], -1)), qtype)
+    gb(p, "conv_bias", vt + "conv.bias")
+
+    p["glu_proj"] = quantize_weight(get(vt + "linear_proj.linear_proj.weight"),
+                                    qtype)
+    p["glu_ln"] = jnp.asarray(get(vt + "linear_proj.norm1.weight"),
+                              jnp.float32)
+    gb(p, "glu_ln_b", vt + "linear_proj.norm1.bias")
+    p["glu_gate"] = quantize_weight(get(vt + "linear_proj.gate_proj.weight"),
+                                    qtype)
+    p["glu_h4h"] = quantize_weight(
+        get(vt + "linear_proj.dense_h_to_4h.weight"), qtype)
+    p["glu_4hh"] = quantize_weight(
+        get(vt + "linear_proj.dense_4h_to_h.weight"), qtype)
+    p["boi"] = jnp.asarray(get(vt + "boi"), jnp.float32).reshape(1, 1, -1)
+    p["eoi"] = jnp.asarray(get(vt + "eoi"), jnp.float32).reshape(1, 1, -1)
+    return p
+
+
+@partial(jax.jit, static_argnames=("vc",))
+def eva_vision_forward(vc: EVAVisionConfig, params: dict,
+                       pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels [B, 3, H, W] -> [B, 2 + (grid/2)^2, text_hidden]
+    (boi ++ projected patches ++ eoi)."""
+    b, c, hh, ww = pixels.shape
+    ps = vc.patch_size
+    gh, gw = hh // ps, ww // ps
+    patches = pixels.reshape(b, c, gh, ps, gw, ps).transpose(0, 2, 4, 1, 3, 5)
+    patches = patches.reshape(b, gh * gw, c * ps * ps).astype(jnp.bfloat16)
+    x = linear_ops.linear(patches, params["patch_proj"],
+                          params.get("patch_bias")).astype(jnp.float32)
+    cls = jnp.broadcast_to(params["cls_token"][None], (b, 1, vc.hidden_size))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"][None]
+    n = x.shape[1]
+    nh, hd = vc.num_heads, vc.head_dim
+
+    def block(x, lp):
+        hb = x.astype(jnp.bfloat16)
+        qkv = linear_ops.linear(hb, lp["qkv"], lp.get("qkv_b"))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        from ipex_llm_tpu.ops.attention import sdpa_reference
+
+        attn = sdpa_reference(
+            q.reshape(b, n, nh, hd), k.reshape(b, n, nh, hd),
+            v.reshape(b, n, nh, hd), causal=False,
+        ).reshape(b, n, vc.hidden_size)
+        o = linear_ops.linear(attn, lp["o"], lp.get("o_b")).astype(jnp.float32)
+        # post-sublayer norm: residual adds the NORMED output
+        x = x + layer_norm(o, lp["ln1"], lp.get("ln1_b"), vc.norm_eps)
+        inner = mlp_ops.act(
+            linear_ops.linear(x.astype(jnp.bfloat16), lp["fc1"],
+                              lp.get("fc1_b")), vc.act)
+        mo = linear_ops.linear(inner, lp["fc2"], lp.get("fc2_b")
+                               ).astype(jnp.float32)
+        x = x + layer_norm(mo, lp["ln2"], lp.get("ln2_b"), vc.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = x[:, 1:]                                     # drop cls
+    g = vc.grid
+    # stride-2 conv as a 2x2-patch matmul; weight rows ordered (C, dh, dw)
+    v4 = x.reshape(b, g, g, vc.hidden_size).transpose(0, 3, 1, 2)
+    v4 = v4.reshape(b, vc.hidden_size, g // 2, 2, g // 2, 2)
+    v4 = v4.transpose(0, 2, 4, 1, 3, 5).reshape(
+        b, (g // 2) * (g // 2), vc.hidden_size * 4)
+    x = linear_ops.linear(v4.astype(jnp.bfloat16), params["conv_proj"],
+                          params.get("conv_bias")).astype(jnp.float32)
+    if vc.scaling_factor != 1.0:
+        x = x / vc.scaling_factor
+    h = linear_ops.linear(x.astype(jnp.bfloat16), params["glu_proj"])
+    h = mlp_ops.act(
+        layer_norm(h.astype(jnp.float32), params["glu_ln"],
+                   params.get("glu_ln_b"), 1e-5).astype(jnp.bfloat16),
+        "gelu")
+    gate = linear_ops.linear(h, params["glu_gate"])
+    up = linear_ops.linear(h, params["glu_h4h"])
+    h = mlp_ops.gated_act_mul(gate, up, "silu")
+    out = linear_ops.linear(h, params["glu_4hh"]).astype(jnp.float32)
+    boi = jnp.broadcast_to(params["boi"], (b, 1, out.shape[-1]))
+    eoi = jnp.broadcast_to(params["eoi"], (b, 1, out.shape[-1]))
+    return jnp.concatenate([boi, out, eoi], axis=1)

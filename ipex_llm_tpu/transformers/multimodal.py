@@ -851,9 +851,145 @@ class TPUGemma3ForConditionalGeneration(TPUInternVLForConditionalGeneration):
             "checkpoint instead")
 
 
+class TPUChatGLM4VForConditionalGeneration:
+    """GLM-4V: EVA2-CLIP tower + conv-downsample GLU projector + chatglm
+    text (reference transformers/models/chatglm4v.py).  The prompt carries
+    ``[boi, placeholder, eoi]``; the projector output (which includes the
+    learned boi/eoi embeddings) replaces those three slots, and rope
+    positions repeat boi+1 across the patch span (chatglm4v.py:76-89)."""
+
+    def __init__(self, cfg, vcfg, params: dict, vparams: dict,
+                 hf_config: dict, qtype: str):
+        self.config = cfg
+        self.vision_config = vcfg
+        self.params = params
+        self.vision_params = vparams
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.boi_token_id = hf_config.get("boi_token_id")
+        self.eoi_token_id = hf_config.get("eoi_token_id")
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_eva import (
+            EVAVisionConfig,
+            build_eva_vision_params,
+        )
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        fam = get_family(hf_config.get("model_type", "chatglm"))
+        cfg = fam.to_config(hf_config)
+        vcfg = EVAVisionConfig.from_hf(hf_config["vision_config"])
+        reader = CheckpointReader(path)
+        params = build_params(cfg, fam.scheme, reader.get, reader.has,
+                              qtype=qtype, qkv_transform=fam.qkv_transform)
+        vparams = build_eva_vision_params(vcfg, reader.get, reader.has, qtype)
+        return cls(cfg, vcfg, params, vparams, hf_config, qtype)
+
+    def _splice(self, ids: np.ndarray, pixel_values):
+        """Returns (embeds [1, N, H], rope positions [1, N], n_tokens)."""
+        from ipex_llm_tpu.models.vision_eva import eva_vision_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        L = len(ids)
+        x = embed_lookup(self.params["embed"], jnp.asarray(ids[None]),
+                         jnp.bfloat16)
+        pos = np.arange(L, dtype=np.int32)
+        if pixel_values is None:
+            return x, jnp.asarray(pos[None]), L
+        px = jnp.asarray(np.asarray(pixel_values, np.float32))
+        if px.ndim == 3:
+            px = px[None]
+        img = eva_vision_forward(self.vision_config, self.vision_params, px)
+        boi = int(np.nonzero(ids == self.boi_token_id)[0][0])
+        eoi = int(np.nonzero(ids == self.eoi_token_id)[0][0])
+        assert eoi - boi == 2, f"boi/eoi span must be 3 tokens, got {ids}"
+        img = img.astype(x.dtype)
+        x = jnp.concatenate([x[:, :boi], img, x[:, eoi + 1:]], axis=1)
+        n_img = img.shape[1]
+        new_pos = np.concatenate([
+            pos[: boi + 1],
+            np.full((n_img - 2,), pos[boi + 1], np.int32),
+            pos[eoi:],
+        ])
+        assert len(new_pos) == x.shape[1], (len(new_pos), x.shape)
+        return x, jnp.asarray(new_pos[None]), L
+
+    def forward_logits(self, input_ids, pixel_values=None, **kwargs):
+        from ipex_llm_tpu import kv as kv_mod
+        from ipex_llm_tpu.models.decoder import decoder_forward
+
+        x, pos, _ = self._splice(input_ids, pixel_values)
+        n = x.shape[1]
+        cache = kv_mod.make_cache(
+            "normal", self.config.num_layers, 1, n,
+            self.config.num_kv_heads, self.config.head_dim,
+            v_head_dim=self.config.v_dim,
+        )
+        dummy = jnp.zeros((1, n), jnp.int32)
+        logits, _ = decoder_forward(self.config, self.params, dummy, cache,
+                                    pos, input_embeds=x)
+        return logits
+
+    def generate(self, input_ids, pixel_values=None, max_new_tokens: int = 32,
+                 **kwargs):
+        from ipex_llm_tpu import kv as kv_mod
+
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        x, pos, L = self._splice(ids, pixel_values)
+        n = x.shape[1]
+        cache = kv_mod.make_cache(
+            "normal", self.config.num_layers, 1, n + max_new_tokens,
+            self.config.num_kv_heads, self.config.head_dim,
+            v_head_dim=self.config.v_dim,
+        )
+        dummy = jnp.zeros((1, n), jnp.int32)
+        logits, cache = _mm_prefill(self.config, self.params, cache, dummy,
+                                    pos, x)
+        out = list(ids)
+        eos = _eos_set(self.hf_config)
+        tok = int(jnp.argmax(logits[0]))
+        for step in range(max_new_tokens):
+            out.append(tok)
+            if tok in eos:
+                break
+            logits, cache = _mm_decode(
+                self.config, self.params, cache,
+                jnp.asarray([[tok]], jnp.int32),
+                jnp.asarray([[L + step]], jnp.int32),
+            )
+            tok = int(jnp.argmax(logits[0, -1]))
+        return np.asarray(out, np.int32)[None]
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(
+            path, {"text": self.params, "vision": self.vision_params},
+            self.hf_config, self.qtype,
+        )
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.families import get_family
+        from ipex_llm_tpu.models.vision_eva import EVAVisionConfig
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        cfg = get_family(hf.get("model_type", "chatglm")).to_config(hf)
+        vcfg = EVAVisionConfig.from_hf(hf["vision_config"])
+        return cls(cfg, vcfg, tree["text"], tree["vision"], hf, qtype)
+
+
 class AutoModelForVision2Seq:
     """Vision-language loader dispatching by model_type (qwen2_vl,
-    internvl, llava, mllama, janus, qwen-vl v1, minicpmv, gemma3)."""
+    internvl, llava, mllama, janus, qwen-vl v1, minicpmv, gemma3,
+    chatglm4v)."""
 
     @classmethod
     def from_pretrained(cls, path: str, **kwargs):
@@ -893,9 +1029,13 @@ class AutoModelForVision2Seq:
             return TPUGemma3ForConditionalGeneration.from_pretrained(
                 str(path), **kwargs
             )
+        if mt in ("chatglm", "glm4v") and "vision_config" in hf:
+            return TPUChatGLM4VForConditionalGeneration.from_pretrained(
+                str(path), **kwargs
+            )
         raise ValueError(
             f"AutoModelForVision2Seq supports qwen2_vl/internvl/llava/"
-            f"mllama/janus/qwen(-vl v1)/minicpmv; got {mt!r}"
+            f"mllama/janus/qwen(-vl v1)/minicpmv/gemma3/chatglm4v; got {mt!r}"
         )
 
     @classmethod
@@ -925,7 +1065,10 @@ class AutoModelForVision2Seq:
             )
 
             return TPUMllamaForConditionalGeneration.load_low_bit(str(path))
+        if mt in ("chatglm", "glm4v"):
+            return TPUChatGLM4VForConditionalGeneration.load_low_bit(
+                str(path))
         raise ValueError(
             f"load_low_bit supports qwen2_vl/internvl/llava/mllama/janus/"
-            f"qwen(-vl v1)/minicpmv; got {mt!r}"
+            f"qwen(-vl v1)/minicpmv/chatglm4v; got {mt!r}"
         )

@@ -1,0 +1,250 @@
+"""GLM-4V multimodal family (VERDICT r3 missing #3).
+
+The EVA2-CLIP tower's post-sublayer norms, conv downsample, and GLU
+projector are verified against a literal torch oracle transcribed from the
+reference's patched forwards (chatglm4v.py:263-301 + the THUDM visual.py
+structure those patches address); the text path must equal the plain
+chatglm model when no image is present; with an image, the boi/eoi splice
+and repeated rope positions are exercised end-to-end."""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+GLM_CFG = {
+    "model_type": "chatglm",
+    "hidden_size": 64, "num_layers": 2, "num_attention_heads": 4,
+    "multi_query_attention": True, "multi_query_group_num": 2,
+    "kv_channels": 16, "ffn_hidden_size": 96, "padded_vocab_size": 160,
+    "layernorm_epsilon": 1e-5, "seq_length": 512, "add_qkv_bias": True,
+    "boi_token_id": 151, "eoi_token_id": 152, "eos_token_id": 2,
+}
+VIS_CFG = {
+    "hidden_size": 32, "num_hidden_layers": 2, "num_heads": 4,
+    "intermediate_size": 64, "patch_size": 4, "image_size": 16,
+    "layer_norm_eps": 1e-6, "hidden_act": "gelu", "scaling_factor": 2.0,
+}
+
+
+def _glm_text_tensors(rng):
+    h, ffn, v, L = 64, 96, 160, 2
+    nkv, hd = 2, 16
+    t = {
+        "transformer.embedding.word_embeddings.weight":
+            rng.standard_normal((v, h)).astype(np.float32) * 0.05,
+        "transformer.encoder.final_layernorm.weight":
+            np.ones((h,), np.float32),
+        "transformer.output_layer.weight":
+            rng.standard_normal((v, h)).astype(np.float32) * 0.05,
+    }
+    for i in range(L):
+        p = f"transformer.encoder.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.ones((h,), np.float32)
+        t[p + "post_attention_layernorm.weight"] = np.ones((h,), np.float32)
+        t[p + "self_attention.query_key_value.weight"] = (
+            rng.standard_normal((h + 2 * nkv * hd, h)).astype(np.float32)
+            * 0.05)
+        t[p + "self_attention.query_key_value.bias"] = (
+            rng.standard_normal(h + 2 * nkv * hd).astype(np.float32) * 0.05)
+        t[p + "self_attention.dense.weight"] = (
+            rng.standard_normal((h, h)).astype(np.float32) * 0.05)
+        t[p + "mlp.dense_h_to_4h.weight"] = (
+            rng.standard_normal((2 * ffn, h)).astype(np.float32) * 0.05)
+        t[p + "mlp.dense_4h_to_h.weight"] = (
+            rng.standard_normal((h, ffn)).astype(np.float32) * 0.05)
+    return t
+
+
+def _eva_tensors(rng):
+    vh, vi, L, ps = 32, 64, 2, 4
+    n_pos = (16 // ps) ** 2 + 1
+    t = {
+        "transformer.vision.patch_embedding.proj.weight":
+            rng.standard_normal((vh, 3, ps, ps)).astype(np.float32) * 0.1,
+        "transformer.vision.patch_embedding.proj.bias":
+            rng.standard_normal(vh).astype(np.float32) * 0.1,
+        "transformer.vision.patch_embedding.cls_embedding":
+            rng.standard_normal((1, vh)).astype(np.float32) * 0.1,
+        "transformer.vision.patch_embedding.position_embedding.weight":
+            rng.standard_normal((n_pos, vh)).astype(np.float32) * 0.1,
+        "transformer.vision.conv.weight":
+            rng.standard_normal((vh, vh, 2, 2)).astype(np.float32) * 0.1,
+        "transformer.vision.conv.bias":
+            rng.standard_normal(vh).astype(np.float32) * 0.1,
+        "transformer.vision.linear_proj.linear_proj.weight":
+            rng.standard_normal((64, vh)).astype(np.float32) * 0.1,
+        "transformer.vision.linear_proj.norm1.weight":
+            np.ones((64,), np.float32),
+        "transformer.vision.linear_proj.norm1.bias":
+            np.zeros((64,), np.float32),
+        "transformer.vision.linear_proj.gate_proj.weight":
+            rng.standard_normal((96, 64)).astype(np.float32) * 0.1,
+        "transformer.vision.linear_proj.dense_h_to_4h.weight":
+            rng.standard_normal((96, 64)).astype(np.float32) * 0.1,
+        "transformer.vision.linear_proj.dense_4h_to_h.weight":
+            rng.standard_normal((64, 96)).astype(np.float32) * 0.1,
+        "transformer.vision.boi":
+            rng.standard_normal((1, 1, 64)).astype(np.float32) * 0.1,
+        "transformer.vision.eoi":
+            rng.standard_normal((1, 1, 64)).astype(np.float32) * 0.1,
+    }
+    for i in range(L):
+        p = f"transformer.vision.transformer.layers.{i}."
+        for nm, shape in (
+            ("attention.query_key_value", (3 * vh, vh)),
+            ("attention.dense", (vh, vh)),
+            ("mlp.fc1", (vi, vh)),
+            ("mlp.fc2", (vh, vi)),
+        ):
+            t[p + nm + ".weight"] = (
+                rng.standard_normal(shape).astype(np.float32) * 0.1)
+            t[p + nm + ".bias"] = (
+                rng.standard_normal(shape[0]).astype(np.float32) * 0.1)
+        for nm in ("input_layernorm", "post_attention_layernorm"):
+            t[p + nm + ".weight"] = np.ones((vh,), np.float32)
+            t[p + nm + ".bias"] = np.zeros((vh,), np.float32)
+    return t
+
+
+def _save(tmp_path, name, config, tensors):
+    import safetensors.numpy
+
+    path = tmp_path / name
+    path.mkdir()
+    safetensors.numpy.save_file(
+        {k: np.ascontiguousarray(v) for k, v in tensors.items()},
+        str(path / "model.safetensors"))
+    (path / "config.json").write_text(json.dumps(config))
+    return str(path)
+
+
+def _torch_eva_oracle(tensors, px):
+    """Literal transcription of the GLM-4V vision semantics the reference
+    patches (chatglm4v.py:263-301): post-sublayer norms, stride-2 conv,
+    scaling-factor divide, CogVLM GLU, boi/eoi bracket."""
+    import torch.nn.functional as F
+
+    g = lambda n: torch.from_numpy(
+        np.ascontiguousarray(tensors["transformer.vision." + n])).float()
+    x = F.conv2d(px, g("patch_embedding.proj.weight"),
+                 g("patch_embedding.proj.bias"), stride=4)
+    b = px.shape[0]
+    x = x.flatten(2).transpose(1, 2)                 # [B, N, H]
+    cls = g("patch_embedding.cls_embedding").expand(b, -1, -1)
+    x = torch.cat([cls, x], dim=1)
+    x = x + g("patch_embedding.position_embedding.weight")[None]
+    vh, nh = 32, 4
+    for i in range(2):
+        p = f"transformer.layers.{i}."
+        qkv = x @ g(p + "attention.query_key_value.weight").T \
+            + g(p + "attention.query_key_value.bias")
+        q, k, v = qkv.chunk(3, dim=-1)
+        n = x.shape[1]
+        q = q.view(b, n, nh, vh // nh).transpose(1, 2)
+        k = k.view(b, n, nh, vh // nh).transpose(1, 2)
+        v = v.view(b, n, nh, vh // nh).transpose(1, 2)
+        a = F.scaled_dot_product_attention(q, k, v)
+        a = a.transpose(1, 2).reshape(b, n, vh)
+        o = a @ g(p + "attention.dense.weight").T \
+            + g(p + "attention.dense.bias")
+        o = F.layer_norm(o, (vh,), g(p + "input_layernorm.weight"),
+                         g(p + "input_layernorm.bias"), 1e-6)
+        x = x + o
+        m = x @ g(p + "mlp.fc1.weight").T + g(p + "mlp.fc1.bias")
+        m = F.gelu(m) @ g(p + "mlp.fc2.weight").T + g(p + "mlp.fc2.bias")
+        m = F.layer_norm(m, (vh,), g(p + "post_attention_layernorm.weight"),
+                         g(p + "post_attention_layernorm.bias"), 1e-6)
+        x = x + m
+    x = x[:, 1:]
+    grid = 4
+    x = x.transpose(1, 2).reshape(b, vh, grid, grid)
+    x = F.conv2d(x, g("conv.weight"), g("conv.bias"), stride=2)
+    x = x.flatten(2).transpose(1, 2)                 # [B, 4, vh]
+    x = x / VIS_CFG["scaling_factor"]
+    x = x @ g("linear_proj.linear_proj.weight").T
+    x = F.gelu(F.layer_norm(x, (64,), g("linear_proj.norm1.weight"),
+                            g("linear_proj.norm1.bias"), 1e-5))
+    gate = F.silu(x @ g("linear_proj.gate_proj.weight").T)
+    up = x @ g("linear_proj.dense_h_to_4h.weight").T
+    x = (gate * up) @ g("linear_proj.dense_4h_to_h.weight").T
+    boi = g("boi").expand(b, -1, -1)
+    eoi = g("eoi").expand(b, -1, -1)
+    return torch.cat([boi, x, eoi], dim=1).numpy()
+
+
+def test_eva_tower_matches_torch_oracle():
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.models.vision_eva import (EVAVisionConfig,
+                                                build_eva_vision_params,
+                                                eva_vision_forward)
+
+    rng = np.random.default_rng(21)
+    tensors = _eva_tensors(rng)
+    vcfg = EVAVisionConfig.from_hf(VIS_CFG)
+    vp = build_eva_vision_params(vcfg, lambda n: tensors[n],
+                                 lambda n: n in tensors, "bf16")
+    px = rng.standard_normal((1, 3, 16, 16)).astype(np.float32)
+    got = np.asarray(eva_vision_forward(vcfg, vp, jnp.asarray(px)),
+                     np.float32)
+    want = _torch_eva_oracle(tensors, torch.from_numpy(px).float())
+    assert got.shape == want.shape == (1, 6, 64)  # boi + 4 patches + eoi
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.06
+
+
+@pytest.fixture(scope="module")
+def glm4v_path(tmp_path_factory):
+    rng = np.random.default_rng(22)
+    tensors = {**_glm_text_tensors(rng), **_eva_tensors(rng)}
+    cfg = dict(GLM_CFG, vision_config=VIS_CFG)
+    return _save(tmp_path_factory.mktemp("glm4v"), "glm4v", cfg, tensors), \
+        tensors
+
+
+def test_text_only_matches_plain_chatglm(glm4v_path, tmp_path):
+    """No image: chatglm4v logits == the plain chatglm text model."""
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+    from ipex_llm_tpu.transformers.multimodal import AutoModelForVision2Seq
+
+    path, tensors = glm4v_path
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    ids = np.array([3, 17, 9, 42, 7], np.int32)
+    got = np.asarray(m.forward_logits(ids), np.float32)
+
+    text_only = {k: v for k, v in tensors.items()
+                 if not k.startswith("transformer.vision.")}
+    tp = _save(tmp_path, "glm_text", GLM_CFG, text_only)
+    ref = AutoModelForCausalLM.from_pretrained(tp, load_in_low_bit="bf16")
+    want = np.asarray(ref(ids[None]), np.float32)
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+
+def test_image_splice_and_generate(glm4v_path):
+    from ipex_llm_tpu.transformers.multimodal import AutoModelForVision2Seq
+
+    path, _ = glm4v_path
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    # prompt: text, [boi, placeholder, eoi], text
+    ids = np.array([3, 17, 151, 0, 152, 9, 42], np.int32)
+    px = np.random.default_rng(23).standard_normal((1, 3, 16, 16)) \
+        .astype(np.float32)
+    logits = np.asarray(m.forward_logits(ids, pixel_values=px), np.float32)
+    # spliced length: 7 - 3 placeholder + (boi + 4 patches + eoi) = 10
+    assert logits.shape[1] == 10
+    assert np.isfinite(logits).all()
+
+    out = m.generate(ids, pixel_values=px, max_new_tokens=4)
+    assert out.shape[1] == len(ids) + 4
+
+    # save/load roundtrip keeps both towers
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        m.save_low_bit(td)
+        m2 = AutoModelForVision2Seq.load_low_bit(td)
+        lg2 = np.asarray(m2.forward_logits(ids, pixel_values=px), np.float32)
+    np.testing.assert_allclose(lg2, logits, rtol=2e-2, atol=2e-2)
