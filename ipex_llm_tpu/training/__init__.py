@@ -23,8 +23,10 @@ from ipex_llm_tpu.training.qlora import (
 from ipex_llm_tpu.training.checkpoint import TrainCheckpointer
 from ipex_llm_tpu.training.relora import ReLoRATrainer, jagged_cosine_schedule
 from ipex_llm_tpu.training.lisa import LisaTrainer, make_lisa_train_step
+from ipex_llm_tpu.training.hf_trainer import TPUTrainer, patch_transformers_trainer
 
 __all__ = [
+    "TPUTrainer", "patch_transformers_trainer",
     "causal_lm_loss", "make_train_step",
     "LoraConfig", "LoraWeight", "attach_lora", "get_peft_model",
     "init_lora", "make_qlora_train_step", "merge_lora",

@@ -1,0 +1,233 @@
+"""HF-Trainer-compatible bridge (reference training_patch.py:68-223 +
+docs/mddocs/Quickstart/axolotl_quickstart.md).
+
+The reference patches ``transformers.Trainer`` so existing finetune recipes
+run on XPU.  Here the same recipe surface — ``Trainer(model, args,
+train_dataset, data_collator)`` with HF ``TrainingArguments`` — drives the
+TPU-native step functions instead: QLoRA adapters (training/qlora.py) when
+given a ``PeftModel``, full-parameter bf16 training otherwise.  Batches pad
+to power-of-two length buckets so XLA compiles a handful of step programs,
+not one per sequence length.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(n: int, lo: int = 32) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _get(args: Any, name: str, default):
+    v = getattr(args, name, default)
+    return default if v is None else v
+
+
+def _lr_schedule(args: Any, total_steps: int):
+    import optax
+
+    lr = float(_get(args, "learning_rate", 5e-5))
+    warmup = int(_get(args, "warmup_steps", 0))
+    kind = str(_get(args, "lr_scheduler_type", "linear"))
+    if warmup:
+        ramp = optax.linear_schedule(0.0, lr, warmup)
+    if "cosine" in kind:
+        tail = optax.cosine_decay_schedule(lr, max(total_steps - warmup, 1))
+    elif "constant" in kind:
+        tail = optax.constant_schedule(lr)
+    else:  # linear decay, the HF default
+        tail = optax.linear_schedule(lr, 0.0, max(total_steps - warmup, 1))
+    if warmup:
+        return optax.join_schedules([ramp, tail], [warmup])
+    return tail
+
+
+class TPUTrainer:
+    """Drop-in for the ``transformers.Trainer`` finetune surface.
+
+    model: ``TPUModelForCausalLM`` (full bf16 training) or
+    ``training.qlora.PeftModel`` (QLoRA adapters over the frozen quantized
+    base — the reference's get_peft_model flow).
+    """
+
+    def __init__(self, model, args=None, train_dataset=None,
+                 data_collator=None, tokenizer=None, optimizers=(None, None),
+                 **kwargs: Any):
+        self.model = model
+        self.args = args
+        self.train_dataset = train_dataset
+        self.data_collator = data_collator
+        self.tokenizer = tokenizer
+        self._optimizer = optimizers[0]
+        self.state_log: list[dict] = []
+
+    # -- data ---------------------------------------------------------------
+
+    def _batches(self) -> Iterable[np.ndarray]:
+        """Yield (tokens [B, T], mask [B, T]) per step, padded to buckets."""
+        bsz = int(_get(self.args, "per_device_train_batch_size", 4))
+        seed = int(_get(self.args, "seed", 0))
+        data = list(self.train_dataset)
+        order = np.random.default_rng(seed).permutation(len(data))
+        for s in range(0, len(data) - bsz + 1, bsz):
+            rows = [data[int(i)] for i in order[s:s + bsz]]
+            if self.data_collator is not None:
+                feats = self.data_collator(rows)
+                ids = np.asarray(feats["input_ids"])
+                labels = np.asarray(
+                    feats.get("labels", feats["input_ids"]))
+            else:
+                seqs = [np.asarray(r["input_ids"]).reshape(-1) for r in rows]
+                lab = [np.asarray(r.get("labels", r["input_ids"])).reshape(-1)
+                       for r in rows]
+                t = _bucket(max(len(x) for x in seqs))
+                ids = np.zeros((bsz, t), np.int64)
+                labels = np.full((bsz, t), -100, np.int64)
+                for j, (q, l) in enumerate(zip(seqs, lab)):
+                    ids[j, : len(q)] = q
+                    labels[j, : len(l)] = l
+            t = _bucket(ids.shape[1])
+            if ids.shape[1] != t:
+                pad = t - ids.shape[1]
+                ids = np.pad(ids, ((0, 0), (0, pad)))
+                labels = np.pad(labels, ((0, 0), (0, pad)),
+                                constant_values=-100)
+            yield ids.astype(np.int32), (labels != -100).astype(np.float32)
+
+    def _n_steps(self) -> int:
+        bsz = int(_get(self.args, "per_device_train_batch_size", 4))
+        per_epoch = max(len(self.train_dataset) // bsz, 1)
+        max_steps = int(_get(self.args, "max_steps", -1))
+        if max_steps and max_steps > 0:
+            return max_steps
+        return per_epoch * int(_get(self.args, "num_train_epochs", 1))
+
+    # -- training -----------------------------------------------------------
+
+    def _build(self, total_steps: int):
+        import optax
+
+        from ipex_llm_tpu.training.qlora import (PeftModel,
+                                                 make_qlora_train_step)
+        from ipex_llm_tpu.training.step import (causal_lm_loss,
+                                                make_train_step)
+
+        opt = self._optimizer or optax.adamw(
+            _lr_schedule(self.args, total_steps),
+            weight_decay=float(_get(self.args, "weight_decay", 0.0)),
+        )
+
+        # the step fns take one `tokens` pytree: pack (ids, mask) and let
+        # the loss unpack, so the HF labels==-100 convention flows through
+        def masked_loss(cfg, params, pack):
+            ids, mask = pack
+            return causal_lm_loss(cfg, params, ids, loss_mask=mask[:, 1:])
+
+        if isinstance(self.model, PeftModel):
+            step = make_qlora_train_step(self.model.model.config, opt,
+                                         self.model.lora_cfg,
+                                         loss_fn=masked_loss)
+            train_tree = self.model.adapters
+
+            def run(tree, opt_state, ids, mask):
+                return step(tree, opt_state, (ids, mask),
+                            self.model.model.params)
+
+            def commit(tree):
+                self.model.adapters = tree
+        else:
+            step = make_train_step(self.model.config, opt,
+                                   loss_fn=masked_loss)
+            train_tree = self.model.params
+
+            def run(tree, opt_state, ids, mask):
+                return step(tree, opt_state, (ids, mask))
+
+            def commit(tree):
+                self.model.params = tree
+        return opt, train_tree, run, commit
+
+    def train(self):
+        total = self._n_steps()
+        opt, tree, run, commit = self._build(total)
+        opt_state = opt.init(tree)
+        log_every = int(_get(self.args, "logging_steps", 10)) or 10
+        out_dir = _get(self.args, "output_dir", None)
+        save_steps = int(_get(self.args, "save_steps", 0) or 0)
+        epochs = int(_get(self.args, "num_train_epochs", 1))
+
+        n = 0
+        t0 = time.perf_counter()
+        done = False
+        for _ in range(max(epochs, 1)):
+            if done:
+                break
+            for ids, mask in self._batches():
+                tree, opt_state, loss = run(tree, opt_state,
+                                            jnp.asarray(ids),
+                                            jnp.asarray(mask))
+                n += 1
+                if n % log_every == 0 or n == total:
+                    rec = {"step": n, "loss": float(loss),
+                           "elapsed_s": round(time.perf_counter() - t0, 2)}
+                    self.state_log.append(rec)
+                    print(f"step {n}/{total} loss {rec['loss']:.4f}")
+                if save_steps and out_dir and n % save_steps == 0:
+                    commit(tree)
+                    self.save_model(os.path.join(out_dir,
+                                                 f"checkpoint-{n}"))
+                if n >= total:
+                    done = True
+                    break
+        commit(tree)
+        if out_dir:
+            self.save_model(out_dir)
+        return {"global_step": n,
+                "train_loss": (self.state_log[-1]["loss"]
+                               if self.state_log else float("nan"))}
+
+    def save_model(self, output_dir: str):
+        os.makedirs(output_dir, exist_ok=True)
+        from ipex_llm_tpu.training.qlora import PeftModel
+
+        if isinstance(self.model, PeftModel):
+            # adapters-only checkpoint, the peft convention
+            from ipex_llm_tpu.training.checkpoint import TrainCheckpointer
+
+            TrainCheckpointer(os.path.abspath(output_dir)).save(
+                0, self.model.adapters, wait=True)
+        else:
+            self.model.save_low_bit(output_dir)
+
+
+def patch_transformers_trainer():
+    """One-line recipe port (the llm_patch(train=True) companion,
+    reference llm_patching.py:35-71): existing code that builds a
+    ``transformers.Trainer`` gets this TPU trainer instead when the model
+    is one of ours."""
+    import transformers
+
+    orig = transformers.Trainer
+
+    class _Switch:
+        def __new__(cls, model=None, *a, **kw):
+            from ipex_llm_tpu.training.qlora import PeftModel
+            from ipex_llm_tpu.transformers.model import TPUModelForCausalLM
+
+            if isinstance(model, (PeftModel, TPUModelForCausalLM)):
+                return TPUTrainer(model, *a, **kw)
+            return orig(model, *a, **kw)
+
+    transformers.Trainer = _Switch
+    return orig
