@@ -1,0 +1,188 @@
+"""Qwen2.5-Omni audio tower (thinker speech-understanding path).
+
+Reference counterpart: transformers/models/qwen2_5_omni.py
+``qwen2_5_omni_audio_attention_forward`` (block-diagonal attention over
+``cu_seqlens`` windows) in the reference repo; semantics verified against
+the public HF ``Qwen2_5OmniAudioEncoder`` as the test oracle.
+
+TPU-static design: the mel stream splits into ``2*n_window``-frame chunks
+(python-level count, so each mel-length bucket compiles once) that are
+INDEPENDENT through the whole encoder — the convs pad per chunk and the
+attention is block-diagonal per chunk — so chunks run as a batch axis
+through one scanned whisper-style layer body.  Only the final avg-pool /
+ln_post / proj run on the concatenated valid frames.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ipex_llm_tpu.ops import linear as linear_ops
+from ipex_llm_tpu.ops import mlp as mlp_ops
+from ipex_llm_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class OmniAudioConfig:
+    d_model: int
+    num_layers: int
+    num_heads: int
+    ffn_dim: int
+    num_mel_bins: int
+    n_window: int
+    output_dim: int
+    act: str = "gelu"
+
+    @classmethod
+    def from_hf(cls, a: dict) -> "OmniAudioConfig":
+        return cls(
+            d_model=a["d_model"],
+            num_layers=a["encoder_layers"],
+            num_heads=a["encoder_attention_heads"],
+            ffn_dim=a["encoder_ffn_dim"],
+            num_mel_bins=a["num_mel_bins"],
+            n_window=a["n_window"],
+            output_dim=a["output_dim"],
+            act=a.get("activation_function", "gelu"),
+        )
+
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    """Whisper sinusoid table (HF SinusoidsPositionEmbedding formula)."""
+    inc = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-inc * np.arange(channels // 2, dtype=np.float64))
+    t = np.arange(length, dtype=np.float64)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(t), np.cos(t)], axis=1).astype(np.float32)
+
+
+def build_omni_audio_params(ac: OmniAudioConfig, get, has, qtype: str,
+                            prefix: str = "audio_tower.") -> dict:
+    from ipex_llm_tpu.models.build import quantize_weight, stack_layer_trees
+
+    def gb(d, key, n):
+        if has(n):
+            d[key] = jnp.asarray(get(n), jnp.float32)
+
+    p: dict[str, Any] = {
+        "conv1_w": jnp.asarray(get(prefix + "conv1.weight"), jnp.float32),
+        "conv2_w": jnp.asarray(get(prefix + "conv2.weight"), jnp.float32),
+    }
+    gb(p, "conv1_b", prefix + "conv1.bias")
+    gb(p, "conv2_b", prefix + "conv2.bias")
+    layers = []
+    for i in range(ac.num_layers):
+        b = f"{prefix}layers.{i}."
+        lp: dict[str, Any] = {}
+        for key, n in (("ln1", "self_attn_layer_norm"),
+                       ("ln2", "final_layer_norm")):
+            lp[key] = jnp.asarray(get(b + n + ".weight"), jnp.float32)
+            gb(lp, key + "_b", b + n + ".bias")
+        for key, n in (("q", "self_attn.q_proj"), ("k", "self_attn.k_proj"),
+                       ("v", "self_attn.v_proj"),
+                       ("o", "self_attn.out_proj"),
+                       ("fc1", "fc1"), ("fc2", "fc2")):
+            lp[key] = quantize_weight(get(b + n + ".weight"), qtype)
+            gb(lp, key + "_b", b + n + ".bias")
+        layers.append(lp)
+    p["blocks"] = stack_layer_trees(layers)
+    p["ln_post"] = jnp.asarray(get(prefix + "ln_post.weight"), jnp.float32)
+    gb(p, "ln_post_b", prefix + "ln_post.bias")
+    p["proj"] = quantize_weight(get(prefix + "proj.weight"), qtype)
+    gb(p, "proj_b", prefix + "proj.bias")
+    p["pos"] = jnp.asarray(_sinusoids(2 * ac.n_window, ac.d_model))
+    return p
+
+
+def _conv1d(x, w, b, stride: int):
+    """x [B, C_in, T]; w [C_out, C_in, 3]; SAME-1 padding."""
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=((1, 1),),
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    return out if b is None else out + b[None, :, None]
+
+
+@partial(jax.jit, static_argnames=("ac", "n_valid"))
+def omni_audio_forward(ac: OmniAudioConfig, params: dict,
+                       mel: jnp.ndarray, n_valid: int) -> jnp.ndarray:
+    """mel [num_mel_bins, T] (one audio, T static) -> [n_frames, output_dim].
+
+    ``n_valid`` <= T marks real frames (the feature_attention_mask sum);
+    the tail chunk right-pads with zeros exactly like the oracle's
+    padded_and_mask_function.
+    """
+    win = 2 * ac.n_window
+    t = mel.shape[1]
+    n_chunks = -(-n_valid // win)
+    pad = n_chunks * win - t
+    if pad > 0:
+        mel = jnp.pad(mel, ((0, 0), (0, pad)))
+    chunks = mel[:, : n_chunks * win].reshape(
+        ac.num_mel_bins, n_chunks, win).transpose(1, 0, 2)  # [N, mel, win]
+    # per-chunk valid frame mask (tail chunk may be ragged)
+    lens = np.full((n_chunks,), win, np.int32)
+    tail = n_valid - (n_chunks - 1) * win
+    lens[-1] = tail
+    lens_j = jnp.asarray(lens)
+    frame_mask = (jnp.arange(win)[None, :] < lens_j[:, None])  # [N, win]
+
+    x = mlp_ops.act(
+        _conv1d(chunks, params["conv1_w"], params.get("conv1_b"), 1)
+        .astype(jnp.float32), "gelu")
+    x = x * frame_mask[:, None, :]          # oracle masks after conv1
+    x = mlp_ops.act(
+        _conv1d(x, params["conv2_w"], params.get("conv2_b"), 2)
+        .astype(jnp.float32), "gelu")
+    x = x.transpose(0, 2, 1)                # [N, win/2, D]
+    x = x + params["pos"][None, : x.shape[1]]
+    n, fl, d = x.shape
+    nh, hd = ac.num_heads, ac.d_model // ac.num_heads
+    after_lens = (lens_j - 1) // 2 + 1
+    valid = jnp.arange(fl)[None, :] < after_lens[:, None]   # [N, fl]
+
+    from ipex_llm_tpu.ops.attention import sdpa_reference
+
+    def block(x, lp):
+        h = layer_norm(x, lp["ln1"], lp.get("ln1_b"), 1e-5)
+        hb = h.astype(jnp.bfloat16)
+        q = linear_ops.linear(hb, lp["q"], lp.get("q_b"))
+        k = linear_ops.linear(hb, lp["k"], lp.get("k_b"))
+        v = linear_ops.linear(hb, lp["v"], lp.get("v_b"))
+        attn = sdpa_reference(
+            q.reshape(n, fl, nh, hd), k.reshape(n, fl, nh, hd),
+            v.reshape(n, fl, nh, hd), causal=False,
+            kv_len=after_lens,              # block-diag: pad frames masked
+        ).reshape(n, fl, d)
+        x = x + linear_ops.linear(attn, lp["o"], lp.get("o_b")
+                                  ).astype(jnp.float32)
+        h2 = layer_norm(x, lp["ln2"], lp.get("ln2_b"), 1e-5)
+        inner = mlp_ops.act(
+            linear_ops.linear(h2.astype(jnp.bfloat16), lp["fc1"],
+                              lp.get("fc1_b")), ac.act)
+        x = x + linear_ops.linear(inner, lp["fc2"], lp.get("fc2_b")
+                                  ).astype(jnp.float32)
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+
+    # concatenate the chunks' VALID frames.  Chunk counts are static here
+    # (only the final tail is ragged), so a flat gather with a validity
+    # sort keeps shapes static: order frames by (invalid, chunk, idx).
+    flat = x.reshape(n * fl, d)
+    vflat = valid.reshape(n * fl)
+    order = jnp.argsort(jnp.where(vflat, 0, 1), stable=True)
+    total = int(np.sum((lens - 1) // 2 + 1))
+    frames = flat[order][:total]            # [total_valid, D]
+
+    # avg-pool stride 2 over the concatenated stream (crosses chunks)
+    n_out = total // 2
+    pooled = frames[: n_out * 2].reshape(n_out, 2, d).mean(axis=1)
+    out = layer_norm(pooled, params["ln_post"], params.get("ln_post_b"), 1e-5)
+    return linear_ops.linear(out.astype(jnp.bfloat16), params["proj"],
+                             params.get("proj_b")).astype(jnp.float32)

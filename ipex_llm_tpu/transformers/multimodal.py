@@ -851,6 +851,118 @@ class TPUGemma3ForConditionalGeneration(TPUInternVLForConditionalGeneration):
             "checkpoint instead")
 
 
+class TPUQwen2_5OmniThinker:
+    """Qwen2.5-Omni thinker: audio tower (models/audio_omni.py) + qwen2
+    M-ROPE text decoder — the speech+text understanding path (reference
+    models/qwen2_5_omni.py thinker/audio patches).  Audio features replace
+    the prompt's audio placeholder tokens one-for-one (the HF
+    masked_scatter contract); rope positions follow the HF
+    position_ids=None path (sequential, equal t/h/w channels).  The talker
+    / token2wav speech-GENERATION stack is out of scope."""
+
+    def __init__(self, cfg: ModelConfig, acfg, params: dict, aparams: dict,
+                 hf_config: dict, qtype: str):
+        self.config = cfg
+        self.audio_config = acfg
+        self.params = params
+        self.audio_params = aparams
+        self.hf_config = hf_config
+        self.qtype = qtype
+        self.audio_token_id = hf_config.get(
+            "audio_token_index", hf_config.get("audio_token_id"))
+
+    @classmethod
+    def from_pretrained(cls, path: str, **kwargs):
+        from ipex_llm_tpu.models.audio_omni import (OmniAudioConfig,
+                                                    build_omni_audio_params)
+
+        qtype = kwargs.pop("load_in_low_bit", None) or (
+            "sym_int4" if kwargs.pop("load_in_4bit", False) else "bf16"
+        )
+        hf_config = read_config(path)
+        cfg = _qwen2_vl_text_config(hf_config)
+        acfg = OmniAudioConfig.from_hf(hf_config["audio_config"])
+        reader = CheckpointReader(path)
+        params = build_params(cfg, WeightScheme(), reader.get, reader.has,
+                              qtype=qtype)
+        aparams = build_omni_audio_params(acfg, reader.get, reader.has,
+                                          qtype)
+        return cls(cfg, acfg, params, aparams, hf_config, qtype)
+
+    def _embed_multimodal(self, ids: np.ndarray, input_features=None,
+                          feature_attention_mask=None):
+        from ipex_llm_tpu.models.audio_omni import omni_audio_forward
+        from ipex_llm_tpu.ops.embedding import embed_lookup
+
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        x = embed_lookup(self.params["embed"], jnp.asarray(ids[None]),
+                         jnp.bfloat16)
+        if input_features is None:
+            return x
+        mel = jnp.asarray(np.asarray(input_features, np.float32))
+        if mel.ndim == 3:
+            mel = mel[0]
+        n_valid = (int(np.asarray(feature_attention_mask).sum())
+                   if feature_attention_mask is not None else mel.shape[1])
+        audio = omni_audio_forward(self.audio_config, self.audio_params,
+                                   mel, n_valid)
+        (idx,) = np.nonzero(ids == self.audio_token_id)
+        assert len(idx) == audio.shape[0], (
+            f"{len(idx)} audio tokens vs {audio.shape[0]} audio frames")
+        return x.at[0, jnp.asarray(idx)].set(audio.astype(x.dtype))
+
+    def forward_logits(self, input_ids, input_features=None,
+                       feature_attention_mask=None, **kwargs):
+        from ipex_llm_tpu import kv as kv_mod
+        from ipex_llm_tpu.models.decoder import decoder_forward
+
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        x = self._embed_multimodal(ids, input_features,
+                                   feature_attention_mask)
+        cache = kv_mod.make_cache(
+            "normal", self.config.num_layers, 1, len(ids),
+            self.config.num_kv_heads, self.config.head_dim,
+            v_head_dim=self.config.v_dim,
+        )
+        pos = jnp.arange(len(ids))[None, :]
+        logits, _ = decoder_forward(
+            self.config, self.params, jnp.asarray(ids[None]), cache, pos,
+            input_embeds=x,
+        )
+        return logits
+
+    def generate(self, input_ids, input_features=None,
+                 feature_attention_mask=None, max_new_tokens: int = 32,
+                 **kwargs):
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        n_p = len(ids)
+        x = self._embed_multimodal(ids, input_features,
+                                   feature_attention_mask)
+        return _greedy_generate(
+            self, ids, x, jnp.arange(n_p)[None, :],
+            lambda step: jnp.asarray([[n_p + step]], jnp.int32),
+            max_new_tokens,
+        )
+
+    def save_low_bit(self, path: str) -> None:
+        from ipex_llm_tpu.models import serialize
+
+        serialize.save_low_bit(
+            path, {"text": self.params, "audio": self.audio_params},
+            self.hf_config, self.qtype,
+        )
+
+    @classmethod
+    def load_low_bit(cls, path: str):
+        from ipex_llm_tpu.models import serialize
+        from ipex_llm_tpu.models.audio_omni import OmniAudioConfig
+
+        tree, hf, qtype = serialize.load_low_bit(path)
+        cfg = _qwen2_vl_text_config(hf)
+        acfg = OmniAudioConfig.from_hf(hf["audio_config"])
+        return cls(cfg, acfg, tree["text"], tree["audio"], hf, qtype)
+
+
 class TPUChatGLM4VForConditionalGeneration:
     """GLM-4V: EVA2-CLIP tower + conv-downsample GLU projector + chatglm
     text (reference transformers/models/chatglm4v.py).  The prompt carries
@@ -1033,6 +1145,8 @@ class AutoModelForVision2Seq:
             return TPUChatGLM4VForConditionalGeneration.from_pretrained(
                 str(path), **kwargs
             )
+        if mt in ("qwen2_5_omni", "qwen2_5_omni_thinker"):
+            return TPUQwen2_5OmniThinker.from_pretrained(str(path), **kwargs)
         raise ValueError(
             f"AutoModelForVision2Seq supports qwen2_vl/internvl/llava/"
             f"mllama/janus/qwen(-vl v1)/minicpmv/gemma3/chatglm4v; got {mt!r}"
@@ -1068,6 +1182,8 @@ class AutoModelForVision2Seq:
         if mt in ("chatglm", "glm4v"):
             return TPUChatGLM4VForConditionalGeneration.load_low_bit(
                 str(path))
+        if mt in ("qwen2_5_omni", "qwen2_5_omni_thinker"):
+            return TPUQwen2_5OmniThinker.load_low_bit(str(path))
         raise ValueError(
             f"load_low_bit supports qwen2_vl/internvl/llava/mllama/janus/"
             f"qwen(-vl v1)/minicpmv/chatglm4v; got {mt!r}"

@@ -1,0 +1,127 @@
+"""Qwen2.5-Omni thinker (VERDICT r3 missing #3): audio tower + M-ROPE text
+against the public HF implementation as oracle (mainline transformers ships
+Qwen2_5OmniThinkerForConditionalGeneration)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+pytest.importorskip("transformers.models.qwen2_5_omni")
+
+
+@pytest.fixture(scope="module")
+def tiny_omni(tmp_path_factory):
+    from transformers import (Qwen2_5OmniThinkerConfig,
+                              Qwen2_5OmniThinkerForConditionalGeneration)
+
+    cfg = Qwen2_5OmniThinkerConfig(
+        audio_config=dict(d_model=32, encoder_layers=2,
+                          encoder_attention_heads=4, encoder_ffn_dim=64,
+                          num_mel_bins=8, n_window=8,
+                          max_source_positions=64, output_dim=48),
+        vision_config=dict(depth=2, hidden_size=32, intermediate_size=64,
+                           num_heads=4, patch_size=4, spatial_merge_size=2,
+                           temporal_patch_size=2, out_hidden_size=48,
+                           fullatt_block_indexes=[1], window_size=16,
+                           in_channels=3),
+        text_config=dict(hidden_size=48, intermediate_size=96,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, vocab_size=180,
+                         max_position_embeddings=512,
+                         rope_scaling={"mrope_section": [2, 2, 2],
+                                       "rope_type": "default",
+                                       "type": "default"}),
+        audio_token_id=170, image_token_id=171, video_token_id=172,
+    )
+    torch.manual_seed(0)
+    model = Qwen2_5OmniThinkerForConditionalGeneration(cfg).eval()
+    path = str(tmp_path_factory.mktemp("omni"))
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model, cfg
+
+
+def test_text_only_logits_match_hf(tiny_omni):
+    path, hf_model, _ = tiny_omni
+    from ipex_llm_tpu.transformers.multimodal import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    ids = np.random.default_rng(0).integers(0, 160, 9).astype(np.int32)
+    got = np.asarray(m.forward_logits(ids), np.float32)
+    with torch.no_grad():
+        want = hf_model(
+            input_ids=torch.from_numpy(ids[None]).long()
+        ).logits.float().numpy()
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.05
+
+
+def test_audio_tower_matches_hf(tiny_omni):
+    """Chunked conv + block-diagonal attention + pooled projection vs the
+    HF audio encoder, incl. a ragged tail chunk (2.5 windows)."""
+    import jax.numpy as jnp
+
+    path, hf_model, _ = tiny_omni
+    from ipex_llm_tpu.models.audio_omni import (OmniAudioConfig,
+                                                build_omni_audio_params,
+                                                omni_audio_forward)
+
+    ac = OmniAudioConfig.from_hf(hf_model.config.audio_config.to_dict())
+    sd = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    ap = build_omni_audio_params(ac, lambda n: sd[n], lambda n: n in sd,
+                                 "bf16")
+    t_valid = 40  # 2 full 16-frame windows + one 8-frame tail
+    mel = np.random.default_rng(1).standard_normal((8, t_valid)) \
+        .astype(np.float32) * 0.5
+    got = np.asarray(omni_audio_forward(ac, ap, jnp.asarray(mel), t_valid),
+                     np.float32)
+
+    with torch.no_grad():
+        out = hf_model.audio_tower(
+            input_features=torch.from_numpy(mel).float(),
+            feature_lens=torch.tensor([t_valid]),
+            aftercnn_lens=torch.tensor([(16 // 2) * 2 + (8 - 1) // 2 + 1]),
+        ).last_hidden_state.numpy()
+    assert got.shape == out.shape
+    scale = np.abs(out).max()
+    assert np.abs(got - out).max() / scale < 0.06
+
+
+def test_audio_splice_logits_match_hf(tiny_omni):
+    path, hf_model, cfg = tiny_omni
+    from ipex_llm_tpu.transformers.multimodal import AutoModelForVision2Seq
+
+    m = AutoModelForVision2Seq.from_pretrained(path, load_in_low_bit="bf16")
+    t_valid = 32  # 2 windows -> 16 post-conv frames -> 8 audio tokens
+    mel = np.random.default_rng(2).standard_normal((8, t_valid)) \
+        .astype(np.float32) * 0.5
+    n_audio = 8
+    ids = np.array([3, 5] + [170] * n_audio + [9, 11], np.int32)
+    fmask = np.ones((1, t_valid), np.int64)
+
+    got = np.asarray(
+        m.forward_logits(ids, input_features=mel,
+                         feature_attention_mask=fmask), np.float32)
+    with torch.no_grad():
+        want = hf_model(
+            input_ids=torch.from_numpy(ids[None]).long(),
+            input_features=torch.from_numpy(mel[None]).float(),
+            feature_attention_mask=torch.from_numpy(fmask).long(),
+        ).logits.float().numpy()
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.06
+
+    out = m.generate(ids, input_features=mel, feature_attention_mask=fmask,
+                     max_new_tokens=4)
+    assert out.shape[1] == len(ids) + 4
+
+    # low-bit roundtrip
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        m.save_low_bit(td)
+        m2 = AutoModelForVision2Seq.load_low_bit(td)
+        lg2 = np.asarray(
+            m2.forward_logits(ids, input_features=mel,
+                              feature_attention_mask=fmask), np.float32)
+    np.testing.assert_allclose(lg2, got, rtol=2e-2, atol=2e-2)
