@@ -97,8 +97,6 @@ def sdpa(
         and q.shape[1] == k.shape[1]                  # full self-attention
         and q.shape[1] % rm.shape["cp"] == 0
         and kwargs.get("kv_start") is None            # no left padding
-        and kwargs.get("window") is None
-        and kwargs.get("softcap") is None
         and kwargs.get("bias") is None
     ):
         from ipex_llm_tpu.ops.ring_attention import ring_sdpa
@@ -106,6 +104,9 @@ def sdpa(
         return ring_sdpa(
             q, k, v, rm, causal=kwargs.get("causal", True),
             scale=kwargs.get("scale"),
+            window=kwargs.get("window"),
+            window_on=kwargs.get("window_on", True),
+            softcap=kwargs.get("softcap"),
         )
 
     mesh = dispatch.spmd_mesh()
