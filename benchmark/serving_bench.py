@@ -1,0 +1,142 @@
+"""Serving throughput benchmark — tok/s and TTFT vs concurrency.
+
+The north-star metric (BASELINE.md: >=20 decode tok/s/chip) is a SERVING
+number: aggregate tokens/sec through the continuous-batching engine, not
+single-stream generate.  This harness drives ``ServingEngine`` with 1/4/16
+concurrent streams and reports, per level:
+
+  - aggregate decode tok/s (total emitted tokens / wall time),
+  - TTFT p50/p95 (Request.first_token_s, includes queueing + chunked
+    prefill — what a client sees),
+  - per-stream decode tok/s for the scaling story.
+
+Reference peer: the all-in-one batch matrix covers API serving at batch
+1/2/4 (dev/benchmark/all-in-one/run.py:145, arc-perf-transformers-445.yaml);
+vLLM's own benchmark_serving.py measures the same two numbers.  This is the
+TPU-native equivalent over our own paged engine.
+
+Run standalone: ``python benchmark/serving_bench.py`` (tiny model on CPU,
+7B-shaped on TPU), or let bench.py embed ``collect()`` in the BENCH line.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _percentile(xs, p):
+    return float(np.percentile(np.asarray(xs, np.float64), p)) if xs else 0.0
+
+
+def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
+                n_out: int, seed: int = 0) -> dict:
+    """One concurrency level through a fresh engine (fresh prefix cache and
+    page pool so levels don't subsidise each other)."""
+    from ipex_llm_tpu.serving.engine import (Request, ServingEngine,
+                                             stream_tokens)
+
+    rng = np.random.default_rng(seed)
+    prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+               for _ in range(concurrency)]
+    eng = ServingEngine(cfg, params, engine_config).start()
+    try:
+        # warm the decode/prefill programs so compile time doesn't pollute
+        # the throughput window (compile cost is bench.py's compile_s line)
+        w = eng.submit(Request(prompt_ids=prompts[0][:n_in],
+                               max_new_tokens=4))
+        list(stream_tokens(w, timeout=1800))
+
+        reqs = [Request(prompt_ids=p, max_new_tokens=n_out) for p in prompts]
+        outs: dict[int, list[int]] = {}
+
+        def drain(i, r):
+            outs[i] = list(stream_tokens(r, timeout=1800))
+
+        t0 = time.perf_counter()
+        threads = []
+        for i, r in enumerate(reqs):
+            eng.submit(r)
+            th = threading.Thread(target=drain, args=(i, r))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=1800)
+        wall = time.perf_counter() - t0
+
+        total_tokens = sum(len(v) for v in outs.values())
+        ttfts = [r.first_token_s for r in reqs if r.first_token_s > 0]
+        decode_tokens = max(total_tokens - len(reqs), 0)  # tokens after first
+        decode_wall = max(wall - _percentile(ttfts, 50), 1e-9)
+        return {
+            "concurrency": concurrency,
+            "n_in": n_in,
+            "n_out": n_out,
+            "agg_tok_s": round(total_tokens / wall, 2),
+            "decode_tok_s": round(decode_tokens / decode_wall, 2),
+            "per_stream_tok_s": round(total_tokens / wall / concurrency, 2),
+            "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+            "ttft_p95_s": round(_percentile(ttfts, 95), 4),
+            "completed": sum(
+                1 for r in reqs if r.finish_reason in ("length", "stop")),
+        }
+    finally:
+        eng.stop()
+
+
+def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
+            n_out: int | None = None) -> list[dict]:
+    """Structured serving-throughput block for the BENCH artifact."""
+    import jax
+
+    from ipex_llm_tpu.serving.engine import EngineConfig
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if cfg is None:
+        from bench import _build_model
+
+        size = os.environ.get("BENCH_SERVE_SIZE",
+                              "7b" if on_tpu else "tiny")
+        cfg, params = _build_model(size, os.environ.get("BENCH_QTYPE",
+                                                        "sym_int4"))
+    if n_in is None:
+        n_in = int(os.environ.get("BENCH_SERVE_IN", "256" if on_tpu else "32"))
+    if n_out is None:
+        n_out = int(os.environ.get("BENCH_SERVE_OUT",
+                                   "64" if on_tpu else "16"))
+    max_rows = max(levels)
+    ec = EngineConfig(
+        max_rows=max_rows,
+        max_seq_len=max(256, 1 << (n_in + n_out).bit_length()),
+        prefill_bucket=min(256, max(32, n_in)),
+    )
+    out = []
+    for c in levels:
+        try:
+            out.append(bench_level(cfg, params, ec, c, n_in, n_out))
+        except Exception as e:  # noqa: BLE001 — partial matrix beats none
+            print(f"serving_bench skip concurrency={c}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    import jax
+
+    from bench import _tpu_reachable
+
+    # probe in a subprocess FIRST: a wedged axon tunnel hangs backend init
+    # in-process forever (bench.py:133)
+    if not _tpu_reachable(attempts=1, timeout_s=90.0):
+        jax.config.update("jax_platforms", "cpu")
+    print("backend:", jax.default_backend(), file=sys.stderr)
+    for row in collect():
+        print(json.dumps(row))

@@ -134,10 +134,29 @@ def prefill_step(
     kv_start = (tpad - lengths).astype(jnp.int32)
     # logical positions: 0..len-1 right-aligned, clipped at 0 in the pad zone
     pos = jnp.maximum(jnp.arange(tpad)[None, :] - kv_start[:, None], 0)
+    pos = _glm2d_positions(cfg, pos, lengths)
     logits, cache = decoder_forward(
         cfg, params, tokens, cache, pos, kv_start=kv_start, last_token_only=True
     )
     return logits, cache
+
+
+def _glm2d_positions(cfg: ModelConfig, pos: jnp.ndarray,
+                     lengths: jnp.ndarray) -> jnp.ndarray:
+    """chatglm v1 2D position ids from running indices ``pos`` [B, T].
+
+    The prompt convention is [...tokens, gMASK, sop]: tokens before sop
+    (index len-1) take sequence positions 0..len-2 with block 0; sop and
+    every generated token stay at the gMASK position (len-2) while the
+    block channel counts 1, 2, ... (reference chatglm.py 2D rotary;
+    THUDM get_position_ids semantics).  Returns [B, 2, T] (or ``pos``
+    unchanged for non-2D models).
+    """
+    if not cfg.rope_2d:
+        return pos
+    bnd = jnp.maximum(lengths - 1, 1).astype(jnp.int32)[:, None]  # sop index
+    return jnp.stack([jnp.minimum(pos, bnd - 1),
+                      jnp.maximum(pos - bnd + 1, 0)], axis=1)
 
 
 @partial(jax.jit, static_argnames=("cfg", "obs"), donate_argnums=(2,))
@@ -147,6 +166,7 @@ def prefill_collect_step(cfg: ModelConfig, params: dict, cache, tokens,
     b, tpad = tokens.shape
     kv_start = (tpad - lengths).astype(jnp.int32)
     pos = jnp.maximum(jnp.arange(tpad)[None, :] - kv_start[:, None], 0)
+    pos = _glm2d_positions(cfg, pos, lengths)
     logits, cache, obs_q = decoder_forward(
         cfg, params, tokens, cache, pos, kv_start=kv_start,
         last_token_only=True, collect_obs=obs,
@@ -194,7 +214,8 @@ def decode_loop(
         pos = lengths + step - 1            # logical position of `tok`
         logits, cache = decoder_forward(
             cfg, params, tok[:, None], cache,
-            pos[:, None], kv_start=kv_start, last_token_only=True,
+            _glm2d_positions(cfg, pos[:, None], lengths),
+            kv_start=kv_start, last_token_only=True,
         )
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub, sp, prev if sp.repetition_penalty != 1.0 else None)
@@ -394,9 +415,11 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
 
 @partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
 def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, ring_idx, key,
-                gen: GenerationConfig):
+                gen: GenerationConfig, lengths=None):
     logits, cache = decoder_forward(
-        cfg, params, tok[:, None], cache, pos[:, None],
+        cfg, params, tok[:, None], cache,
+        pos[:, None] if lengths is None
+        else _glm2d_positions(cfg, pos[:, None], lengths),
         kv_start=kv_start, last_token_only=True,
     )
     key, sub = jax.random.split(key)
@@ -421,6 +444,7 @@ def _stream_decode(cfg, params, cache, first, lengths, kv_start, prev_ring,
         tok, cache, key, prev_ring = _decode_one(
             cfg, params, cache, tok, pos, kv_start, prev_ring,
             (lengths + step) % REP_WINDOW, key, gen,
+            lengths=lengths if cfg.rope_2d else None,
         )
         row = np.asarray(tok)
         row = np.where(done, gen.pad_token_id, row)

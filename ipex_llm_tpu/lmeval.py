@@ -64,7 +64,17 @@ class IpexLLMTPULM(_LMBase):
     # -- token scoring ------------------------------------------------------
 
     def _encode(self, s: str) -> list[int]:
-        return list(self.tok(s)["input_ids"]) if s else []
+        """Tokenize WITHOUT special tokens (the lm-eval harness convention):
+        context and continuation are encoded separately and concatenated, so
+        a tokenizer that auto-adds BOS/EOS would otherwise splice a BOS into
+        the middle of the scored sequence (advisor r4 finding #1)."""
+        if not s:
+            return []
+        try:
+            ids = self.tok(s, add_special_tokens=False)["input_ids"]
+        except TypeError:  # duck-typed test tokenizers without the kwarg
+            ids = self.tok(s)["input_ids"]
+        return list(ids)
 
     @staticmethod
     def _bucket(n: int) -> int:

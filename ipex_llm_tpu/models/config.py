@@ -63,6 +63,13 @@ class ModelConfig:
     embed_norm: bool = False       # bloom word_embeddings_layernorm
     norm_after: bool = False       # olmo2: x + norm(attn(x)) (no input norm)
     logit_scale: float = 1.0       # cohere final-logit multiplier
+    # chatglm v1 (pre-RMSNorm GLM, reference models/chatglm.py): the residual
+    # base is the LAYERNORMED input scaled by alpha=(2*num_layers)**0.5
+    # (h = ln(x)*alpha + block(ln(x))); 0.0 = standard pre-norm residual
+    glm_alpha: float = 0.0
+    # chatglm v1 2D rotary: first half of head_dim rotates with sequence
+    # positions, second half with generation block positions
+    rope_2d: bool = False
 
     # attention extras
     sliding_window: int | None = None

@@ -69,11 +69,13 @@ def _in_norm(x, lp, key, cfg):
 
 def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
                      q_slots, kv_len, kv_start, sliding, cache: KVCache,
-                     collect_obs: int = 0, bias=None):
+                     collect_obs: int = 0, bias=None, pre_normed=False):
     b, t, _ = x.shape
     # olmo2-style reordered norm: attention sees the raw residual stream
-    # and attn_norm applies to the block OUTPUT instead
-    h = x if cfg.norm_after else _in_norm(x, lp, "attn_norm", cfg)
+    # and attn_norm applies to the block OUTPUT instead; pre_normed: the
+    # caller already normed x (glm_alpha residual needs the normed input)
+    h = (x if cfg.norm_after or pre_normed
+         else _in_norm(x, lp, "attn_norm", cfg))
     q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
     if cfg.is_mla:
         # DeepSeek MLA (reference deepseek.py:274-343): low-rank q, a
@@ -145,7 +147,20 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
         k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_offset)
 
     rd = cfg.rope.rotary_dim if cfg.rope is not None else cfg.head_dim
-    if cfg.rope is not None:
+    if cfg.rope is not None and cfg.rope_2d:
+        # chatglm v1: each head_dim half rotates with its own channel table
+        # (cos/sin arrive concatenated from embed_prelude)
+        d2 = cfg.head_dim // 2
+        f = cos.shape[-1] // 2
+        def rot2(x):
+            return jnp.concatenate([
+                rope_ops.apply_rope(x[..., :d2], cos[..., :f], sin[..., :f],
+                                    "half"),
+                rope_ops.apply_rope(x[..., d2:], cos[..., f:], sin[..., f:],
+                                    "half"),
+            ], axis=-1)
+        q, k = rot2(q), rot2(k)
+    elif cfg.rope is not None:
         if rd == cfg.head_dim:
             q = rope_ops.apply_rope(q, cos, sin, cfg.rope_layout)
             k = rope_ops.apply_rope(k, cos, sin, cfg.rope_layout)
@@ -289,8 +304,9 @@ def _moe_block(cfg: ModelConfig, lp: dict, x):
     return out
 
 
-def _mlp_block(cfg: ModelConfig, lp: dict, x):
-    h = x if cfg.norm_after else _in_norm(x, lp, "mlp_norm", cfg)
+def _mlp_block(cfg: ModelConfig, lp: dict, x, pre_normed=False):
+    h = (x if cfg.norm_after or pre_normed
+         else _in_norm(x, lp, "mlp_norm", cfg))
     if not cfg.mlp_gated:
         # fc1 -> act -> fc2 (phi/gptneox/starcoder2-style MLP)
         inner = mlp_ops.act(
@@ -355,6 +371,20 @@ def embed_prelude(cfg: ModelConfig, params, tokens, rope_positions,
             cos, sin = rope_ops.cos_sin_mrope(
                 mpos, frozen("inv_freq"), cfg.mrope_section
             )
+        elif cfg.rope_2d:
+            # chatglm v1 2D rotary (reference chatglm.py:35-40
+            # apply_rotary_pos_emb_index over 2-channel position ids):
+            # positions [B,2,T] = (sequence, block) channels; a [B,T] input
+            # means "all context" (block channel 0).  The two per-channel
+            # tables ride concatenated; _attention_block splits head_dim in
+            # half and rotates each half with its own table.
+            p2 = rope_positions
+            if p2.ndim == 2:
+                p2 = jnp.stack([p2, jnp.zeros_like(p2)], axis=1)
+            c1, s1 = rope_ops.cos_sin(p2[:, 0], frozen("inv_freq"))
+            c2, s2 = rope_ops.cos_sin(p2[:, 1], frozen("inv_freq"))
+            cos = jnp.concatenate([c1, c2], axis=-1)
+            sin = jnp.concatenate([s1, s2], axis=-1)
         else:
             cos, sin = rope_ops.cos_sin(
                 rope_positions, frozen("inv_freq"), frozen("rope_mscale", 1.0)
@@ -427,6 +457,21 @@ def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
             s_ = jnp.where(sliding, sin_local, sin)
         else:
             c, s_ = cos, sin
+        if cfg.glm_alpha:
+            # chatglm v1 GLM block (reference chatglm.py / THUDM
+            # modeling_chatglm GLMBlock): the residual base is the NORMED
+            # input scaled by alpha=(2*num_layers)**0.5, for both sublayers
+            alpha = jnp.asarray(cfg.glm_alpha, x.dtype)
+            a_in = _in_norm(x, lp, "attn_norm", cfg)
+            attn_out, kl, vl, obs_q = _attention_block(
+                cfg, lp, a_in, kl, vl, c, s_, slot0, q_slots, kv_len,
+                kv_start, sliding, cache, collect_obs, bias=alibi_bias,
+                pre_normed=True,
+            )
+            x = a_in * alpha + attn_out
+            m_in = _in_norm(x, lp, "mlp_norm", cfg)
+            x = m_in * alpha + _mlp_block(cfg, lp, m_in, pre_normed=True)
+            return x, (kl, vl, obs_q)
         attn_out, kl, vl, obs_q = _attention_block(
             cfg, lp, x, kl, vl, c, s_, slot0, q_slots, kv_len, kv_start,
             sliding, cache, collect_obs, bias=alibi_bias,

@@ -285,12 +285,67 @@ def _glm4(hf: dict) -> ModelConfig:
     return replace(_glm(hf), post_attn_norm=True, post_mlp_norm=True)
 
 
+def _chatglm1(hf: dict) -> ModelConfig:
+    """ChatGLM v1 (THUDM/chatglm-6b; reference models/chatglm.py, dispatched
+    at convert.py:1293): pre-RMSNorm GLM — LayerNorm everywhere, GELU
+    non-gated MLP, MHA with per-head-interleaved query_key_value, 2D rotary
+    (half the head dim per position channel), and the GLM alpha-scaled
+    post-LN residual (h = ln(x)*alpha + sublayer(ln(x)),
+    alpha = (2*num_layers)**0.5)."""
+    head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+    n_layers = hf["num_layers"]
+    return ModelConfig(
+        model_type="chatglm",
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf.get("inner_hidden_size",
+                                 4 * hf["hidden_size"]),
+        num_layers=n_layers,
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf["num_attention_heads"],
+        head_dim=head_dim,
+        max_position_embeddings=hf.get("max_sequence_length", 2048),
+        act="gelu",
+        mlp_gated=False,
+        norm_kind="layer",
+        norm_eps=hf.get("layernorm_epsilon", 1e-5),
+        # each 2D channel rotates head_dim/2 dims -> per-channel table over
+        # head_dim/4 frequencies (partial_rotary 0.5 sizes inv_freq)
+        rope=RopeScaling(head_dim=head_dim, base=10000.0,
+                         partial_rotary_factor=0.5),
+        rope_2d=True,
+        glm_alpha=float((2.0 * n_layers) ** 0.5),
+        attention_bias=True,
+        attention_out_bias=True,
+        mlp_bias=True,
+    )
+
+
+_CHATGLM1_SCHEME = WeightScheme(
+    embed="transformer.word_embeddings.weight",
+    final_norm="transformer.final_layernorm.weight",
+    lm_head="lm_head.weight",
+    attn_norm="transformer.layers.{i}.input_layernorm.weight",
+    mlp_norm="transformer.layers.{i}.post_attention_layernorm.weight",
+    qkv="transformer.layers.{i}.attention.query_key_value.{p}",
+    q=None, k=None, v=None,
+    o="transformer.layers.{i}.attention.dense.{p}",
+    gate=None, gate_up=None,
+    up="transformer.layers.{i}.mlp.dense_h_to_4h.{p}",
+    down="transformer.layers.{i}.mlp.dense_4h_to_h.{p}",
+)
+
+
 def _chatglm(hf: dict) -> ModelConfig:
     """Legacy THUDM ``chatglm`` checkpoints (chatglm2/3-6b, glm-4-9b-chat):
     same math as mainline glm, different config keys and weight names
-    (reference chatglm2.py:118-183 config usage)."""
+    (reference chatglm2.py:118-183 config usage).  v1 checkpoints
+    (position_encoding_2d / inner_hidden_size) resolve to the chatglm1
+    family via get_family."""
     if not hf.get("rmsnorm", True) or hf.get("post_layer_norm") is False:
-        raise NotImplementedError("layernorm/post-norm chatglm variants (v1)")
+        raise NotImplementedError(
+            "layernorm/post-norm chatglm variant without v1 markers; "
+            "v1 (position_encoding_2d/inner_hidden_size) is supported")
     head_dim = hf.get("kv_channels",
                       hf["hidden_size"] // hf["num_attention_heads"])
     groups = (hf.get("multi_query_group_num", hf["num_attention_heads"])
@@ -1096,6 +1151,8 @@ FAMILIES: dict[str, Family] = {
     "glm": Family("glm", _glm, _GLM_SCHEME),
     "glm4": Family("glm4", _glm4, _GLM4_SCHEME),
     "chatglm": Family("chatglm", _chatglm, _CHATGLM_SCHEME),
+    "chatglm1": Family("chatglm1", _chatglm1, _CHATGLM1_SCHEME,
+                       qkv_transform=_neox_qkv),
     "deepseek_v2": Family("deepseek_v2", _deepseek_v2, _DEEPSEEK_SCHEME,
                           _DEEPSEEK_MOE),
     "deepseek_v3": Family("deepseek_v3", _deepseek_v3, _DEEPSEEK_SCHEME,
@@ -1114,7 +1171,15 @@ FAMILIES: dict[str, Family] = {
 }
 
 
-def get_family(model_type: str) -> Family:
+def get_family(model_type: str, hf_config: dict | None = None) -> Family:
+    """Resolve a family; ``hf_config`` disambiguates model_types that span
+    architecture generations (THUDM reused ``chatglm`` for v1's layernorm/
+    2D-rope architecture and v2+'s rmsnorm GLM — reference convert.py:1293
+    branches on the same config markers)."""
+    if (model_type == "chatglm" and hf_config is not None
+            and (hf_config.get("position_encoding_2d")
+                 or "inner_hidden_size" in hf_config)):
+        return FAMILIES["chatglm1"]
     if model_type not in FAMILIES:
         raise ValueError(
             f"model_type {model_type!r} not supported yet; "

@@ -45,7 +45,7 @@ def optimize_model(model: Any, low_bit: str = "sym_int4", **kwargs):
             f"got {type(model)}"
         )
     hf_config = model.config.to_dict()
-    family = get_family(hf_config.get("model_type", "llama"))
+    family = get_family(hf_config.get("model_type", "llama"), hf_config)
     cfg = family.to_config(hf_config)
     state = model.state_dict()
 

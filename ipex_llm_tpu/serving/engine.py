@@ -56,9 +56,13 @@ class EngineConfig:
     # speculative serving (reference ipex_llm_worker.py:57 `speculative`
     # load flag): >0 enables prompt-lookup speculative decode steps — each
     # step verifies spec_k host-proposed n-gram candidates per row in ONE
-    # batched T=spec_k+1 forward; greedy rows emit the accepted prefix,
-    # sampled rows take one token.  Decode is bandwidth-bound, so the wider
-    # step costs ~one weight pass but can emit up to spec_k+1 tokens.
+    # batched T=spec_k+1 forward.  Every position samples with the row's
+    # own params, so greedy AND sampled rows emit the accepted prefix with
+    # the plain engine's distribution (seeded rows bit-identically; see
+    # _verify_step).  Decode is bandwidth-bound, so the wider step costs
+    # ~one weight pass but can emit up to spec_k+1 tokens.  On a pp mesh
+    # the verify step runs GSPMD stage-sequential (pp_decode_step pipelines
+    # only T=1 steps); tp meshes shard it like any decode.
     spec_k: int = 0
     spec_ngram: int = 3         # n-gram length for host-side lookup
 
@@ -100,6 +104,9 @@ class Request:
     # None = engine default (on when EngineConfig.spec_k > 0); False opts a
     # request out of speculative acceptance (it still rides the wide step)
     speculative: bool | None = None
+    # per-request draft width, clamped to EngineConfig.spec_k (the trace
+    # width); None = engine default
+    spec_k: int | None = None
 
     def abort(self):
         self.cancelled = True
@@ -229,15 +236,19 @@ def _verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
                  mesh=None):
     """Speculative decode step: ONE [R, k+1] forward over [cur_tok; drafts].
 
-    Position 0 samples with the row's full sampling params (exactly the
-    plain decode step); positions 1..k produce greedy continuations + their
-    logprobs.  The host walks the acceptance chain (emit while the draft
-    fed at position j equals the token emitted at j-1), so greedy rows are
-    token-identical to plain decoding — the reference's lookup_generate
-    guarantee (lookup.py:274) inside continuous batching.  KV for accepted
-    tokens was already written by this forward; rejected slots are dead
-    until overwritten (paged rollback is free, the r3 speculative.py
-    design note).
+    EVERY position samples with the row's full sampling params (position j
+    from p(.|ctx, d_1..d_j), with the row's seeded stream keyed by OUTPUT
+    INDEX).  The host walks the acceptance chain: emit s_0; while the draft
+    fed at position j equals the token just emitted, the j-th continuation
+    s_j is a valid sample from the true conditional — emit it and continue.
+    Each emitted token is therefore distributed exactly as plain decoding
+    (the reference's speculative.py:805 distribution-preservation contract,
+    generalized to temperature>0 — at T=0 this reduces to the greedy
+    token-identical chain, lookup.py:274).  Seeded rows reproduce the plain
+    engine's stream bit-for-bit because fold_in(seed, output_index) is the
+    same key either way.  KV for accepted tokens was already written by this
+    forward; rejected slots are dead until overwritten (paged rollback is
+    free, the r3 speculative.py design note).
     """
     from ipex_llm_tpu.ops import dispatch
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
@@ -249,16 +260,16 @@ def _verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
             cfg, params, tokens, cache, pos, slot_offsets=row_lens,
         )
         key, sub = jax.random.split(key)
-        t0, lp0 = sample_rows_with_logprobs(logits[:, 0], temps, top_ps,
-                                            sub, seeds=seeds, steps=steps,
-                                            top_ks=top_ks)
-        t0 = jnp.where(active, t0, 0)
-        lg = logits[:, 1:].astype(jnp.float32)            # [R, k, V]
-        g = jnp.argmax(lg, axis=-1).astype(jnp.int32)     # [R, k]
-        glp = jnp.take_along_axis(
-            jax.nn.log_softmax(lg, axis=-1), g[..., None], axis=-1
-        )[..., 0]
-    return t0, lp0, g, glp, cache, key
+        subkeys = jax.random.split(sub, k + 1)            # per-position keys
+        steps_mat = steps[:, None] + jnp.arange(k + 1)[None, :]  # [R, k+1]
+        t_all, lp_all = jax.vmap(
+            lambda lg_j, key_j, st_j: sample_rows_with_logprobs(
+                lg_j, temps, top_ps, key_j, seeds=seeds, steps=st_j,
+                top_ks=top_ks),
+            in_axes=(1, 0, 1), out_axes=1,
+        )(logits, subkeys, steps_mat)                     # [R, k+1] each
+        t_all = jnp.where(active[:, None], t_all, 0)
+    return t_all, lp_all, cache, key
 
 
 def _propose_ngram(history: np.ndarray, k: int, ngram: int) -> np.ndarray:
@@ -317,6 +328,19 @@ class ServingEngine:
         sharded, the reference's vLLM-TP-worker serving mode
         (vllm/xpu/engine/engine.py:40) expressed as SPMD instead of Ray
         workers.  None = single-chip (the r3 behaviour)."""
+        if cfg.rope_2d:
+            # chatglm v1 block positions need each row's prompt boundary
+            # threaded through every step; generate() supports it, the paged
+            # engine does not (a 2018-era model is not a serving target)
+            raise NotImplementedError(
+                "2D-rope (chatglm v1) models are generate()-only")
+        if "embed" not in params:
+            # disk_embedding models keep the table in host RAM; the jitted
+            # engine step cannot host-gather per token (model.py:186)
+            raise NotImplementedError(
+                "disk_embedding (streamed host table) models are "
+                "generate()-only — the paged engine needs the embed table "
+                "in HBM")
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
         self.default_eos = default_eos
@@ -601,11 +625,22 @@ class ServingEngine:
         """One speculative (prompt-lookup verify) step over the active rows."""
         k = self.ec.spec_k
         n_rows = len(self.rows)
-        # each row may write up to k+1 fresh KV slots this step
+        # each row may write up to k+1 fresh KV slots this step; a row that
+        # can't get the k+1 slots under pool contention falls back to a
+        # plain single-token step (advisor r4 finding #4: finishing with
+        # 'length' truncated requests the plain engine could still serve) —
+        # its draft KV writes past the allocated page land on the scratch
+        # page via update_layer's valid mask
+        no_spec = np.zeros((n_rows,), bool)
         for i in range(n_rows):
-            if active[i] and not self._ensure_pages(i, int(self.row_lens[i]) + k + 1):
-                self._finish(i, "length")
-                active[i] = False
+            if not active[i]:
+                continue
+            if not self._ensure_pages(i, int(self.row_lens[i]) + k + 1):
+                if self._ensure_pages(i, int(self.row_lens[i]) + 1):
+                    no_spec[i] = True
+                else:
+                    self._finish(i, "length")
+                    active[i] = False
         if not active.any():
             return
         drafts = np.zeros((n_rows, k), np.int32)
@@ -614,22 +649,29 @@ class ServingEngine:
             req = self.rows[i]
             if not active[i] or req is None:
                 continue
-            # speculative acceptance is greedy-only (token-identical); sampled
-            # rows ride the wide step but emit one properly-sampled token
-            if req.temperature == 0 and req.speculative is not False:
+            # acceptance covers ALL temperatures (every verify position
+            # samples with the row's params — see _verify_step); a request
+            # can opt out (speculative=False) or cap its own draft width
+            # (spec_k), the reference ipex_llm_worker.py:57 per-load knobs
+            # made per-request
+            if req.speculative is not False and not no_spec[i]:
+                k_req = k if req.spec_k is None else max(
+                    0, min(int(req.spec_k), k))
+                if k_req == 0:
+                    continue
                 hist = np.concatenate([
                     np.asarray(req.prompt_ids, np.int32),
                     np.asarray(req.output_ids, np.int32),
                 ])
-                d = _propose_ngram(hist, k, self.ec.spec_ngram)
+                d = _propose_ngram(hist, k_req, self.ec.spec_ngram)
                 valid = d >= 0
-                n_prop[i] = k if valid.all() else int(valid.argmin())
-                drafts[i] = np.where(valid, d, 0)
+                n_prop[i] = k_req if valid.all() else int(valid.argmin())
+                drafts[i, :k_req] = np.where(valid, d, 0)
         cache = replace(self.cache, tables=jnp.asarray(self.tables))
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)
-        t0, lp0, g, glp, self.cache, self.key = _verify_step(
+        t_all, lp_all, self.cache, self.key = _verify_step(
             self.cfg, self.params, cache,
             jnp.asarray(self.toks), jnp.asarray(drafts),
             jnp.asarray(self.row_lens), jnp.asarray(active),
@@ -637,23 +679,22 @@ class ServingEngine:
             jnp.asarray(self.seeds), jnp.asarray(steps),
             jnp.asarray(self.top_ks), k=k, mesh=self.mesh,
         )
-        t0, lp0, g, glp = (np.asarray(a) for a in (t0, lp0, g, glp))
+        t_all, lp_all = np.asarray(t_all), np.asarray(lp_all)
         self.metrics["steps"] += 1
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
         emitted_total = 0
         for i in range(n_rows):
             if not active[i] or self.rows[i] is None:
                 continue
-            req = self.rows[i]
-            emitted = [(int(t0[i]), float(lp0[i]))]
-            if req.temperature == 0 and req.speculative is not False:
-                for j in range(int(n_prop[i])):
-                    # the draft fed at position j+1 must equal the token the
-                    # verify step emitted at position j for logits[j+1] to be
-                    # a real continuation
-                    if int(drafts[i, j]) != emitted[-1][0]:
-                        break
-                    emitted.append((int(g[i, j]), float(glp[i, j])))
+            emitted = [(int(t_all[i, 0]), float(lp_all[i, 0]))]
+            for j in range(int(n_prop[i])):
+                # the draft fed at position j+1 must equal the token just
+                # emitted for logits[j+1] (and thus sample s_{j+1}) to be a
+                # draw from the true conditional
+                if int(drafts[i, j]) != emitted[-1][0]:
+                    break
+                emitted.append((int(t_all[i, j + 1]),
+                                float(lp_all[i, j + 1])))
             # KV for every emitted token except the last is already in the
             # pool (the forward wrote slots row_len..row_len+k); the last
             # emitted token is the next step's input, written then
@@ -668,9 +709,15 @@ class ServingEngine:
         self.metrics["spec_emitted"] = (
             self.metrics.get("spec_emitted", 0) + emitted_total
         )
+        # normalize by ACTIVE ROW-STEPS, not steps: with concurrent rows a
+        # per-step divisor both overstated the rate (could exceed 1.0) and
+        # understated it when rows sat idle (advisor r4 finding #2)
+        self.metrics["spec_row_steps"] = (
+            self.metrics.get("spec_row_steps", 0) + int(active.sum())
+        )
         self.metrics["spec_accept_rate"] = round(
             self.metrics["spec_emitted"]
-            / ((k + 1) * self.metrics["spec_steps"]), 4)
+            / ((k + 1) * max(self.metrics["spec_row_steps"], 1)), 4)
 
     def _loop(self):
         while not self._stop.is_set():

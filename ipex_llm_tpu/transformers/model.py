@@ -137,7 +137,7 @@ class TPUModelForCausalLM:
                     if hf_config["model_type"] == "yuan"
                     else TPUBaichuanM1ForCausalLM)
             return cls2.from_pretrained(path, load_in_low_bit=qtype)
-        family = get_family(hf_config.get("model_type", "llama"))
+        family = get_family(hf_config.get("model_type", "llama"), hf_config)
         cfg = family.to_config(hf_config)
         reader = CheckpointReader(path)
         qc = hf_config.get("quantization_config")
@@ -234,7 +234,7 @@ class TPUModelForCausalLM:
         ``mesh`` shards the reloaded params under the TP rules, matching the
         ``from_pretrained(..., mesh=...)`` path."""
         params, hf_config, qtype = serialize.load_low_bit(path)
-        family = get_family(hf_config.get("model_type", "llama"))
+        family = get_family(hf_config.get("model_type", "llama"), hf_config)
         cfg = family.to_config(hf_config)
         model = cls(cfg, params, hf_config, qtype)
         if mesh is not None:
