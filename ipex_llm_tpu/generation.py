@@ -128,6 +128,7 @@ def prefill_step(
     cache,
     tokens: jnp.ndarray,      # [B, Tpad] left-padded
     lengths: jnp.ndarray,     # [B]
+    input_embeds: jnp.ndarray | None = None,  # streamed-embedding path
 ):
     """Run the prompt through the decoder; returns (last_logits [B,V], cache)."""
     b, tpad = tokens.shape
@@ -136,7 +137,8 @@ def prefill_step(
     pos = jnp.maximum(jnp.arange(tpad)[None, :] - kv_start[:, None], 0)
     pos = _glm2d_positions(cfg, pos, lengths)
     logits, cache = decoder_forward(
-        cfg, params, tokens, cache, pos, kv_start=kv_start, last_token_only=True
+        cfg, params, tokens, cache, pos, kv_start=kv_start,
+        last_token_only=True, input_embeds=input_embeds,
     )
     return logits, cache
 
@@ -265,6 +267,7 @@ def generate(
     kv_kind: str = "auto",
     streamer: Callable[[np.ndarray], None] | None = None,
     mesh=None,
+    host_embed: np.ndarray | None = None,
 ) -> GenerateResult:
     """End-to-end generate.  ``input_ids``: list of token lists or [B, T] array.
 
@@ -280,6 +283,11 @@ def generate(
     gen = generation_config
     tokens, lengths, tpad = pad_batch(input_ids, gen.pad_token_id)
     b = tokens.shape[0]
+
+    if host_embed is not None and kv_kind == "auto":
+        # SnapKV's prefill_collect path has no input_embeds form; the
+        # streamed-embedding user trades that optimization away
+        kv_kind = "normal"
 
     compress = kv_kind == "compress"
     if compress:
@@ -327,12 +335,12 @@ def generate(
     with _dispatch.spmd(mesh if mesh is not None and mesh.size > 1 else None):
         return _generate_inner(
             cfg, params, gen, tokens, lengths, tpad, b, cache, mesh, streamer,
-            compress,
+            compress, host_embed,
         )
 
 
 def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
-                    streamer, compress=False):
+                    streamer, compress=False, host_embed=None):
     tokens_j = jnp.asarray(tokens)
     lengths_j = jnp.asarray(lengths)
     if mesh is not None:
@@ -355,7 +363,13 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
             cap, w, new_total,
         )
     else:
-        logits, cache = prefill_step(cfg, params, cache, tokens_j, lengths_j)
+        pre_emb = None
+        if host_embed is not None:
+            # host gather of the whole padded prompt (one transfer; the
+            # table itself never leaves host RAM)
+            pre_emb = jnp.asarray(host_embed[tokens], jnp.float32)
+        logits, cache = prefill_step(cfg, params, cache, tokens_j, lengths_j,
+                                     input_embeds=pre_emb)
     key = jax.random.PRNGKey(gen.seed)
     key, sub = jax.random.split(key)
     prev_ring = jnp.asarray(_init_prev_ring(tokens, lengths))
@@ -380,7 +394,7 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
             mesh, b, kv_start, prev_ring, first
         )
     t1 = time.perf_counter()
-    if streamer is None:
+    if streamer is None and host_embed is None:
         out, steps, cache = decode_loop(
             cfg, params, cache, first, lengths_j, kv_start, prev_ring, key,
             gen, gen.max_new_tokens,
@@ -388,9 +402,12 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
         out = np.asarray(out)
         steps = int(steps)
     else:
+        # streaming callback or streamed host embedding: decode runs
+        # step-by-step from Python (the host gather cannot live inside a
+        # jitted while_loop)
         out, steps = _stream_decode(
             cfg, params, cache, first, lengths_j, kv_start, prev_ring, key,
-            gen, streamer,
+            gen, streamer, host_embed=host_embed,
         )
     dt = time.perf_counter() - t1
 
@@ -415,12 +432,12 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
 
 @partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
 def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, ring_idx, key,
-                gen: GenerationConfig, lengths=None):
+                gen: GenerationConfig, lengths=None, input_embeds=None):
     logits, cache = decoder_forward(
         cfg, params, tok[:, None], cache,
         pos[:, None] if lengths is None
         else _glm2d_positions(cfg, pos[:, None], lengths),
-        kv_start=kv_start, last_token_only=True,
+        kv_start=kv_start, last_token_only=True, input_embeds=input_embeds,
     )
     key, sub = jax.random.split(key)
     sp = gen.sampling()
@@ -430,26 +447,38 @@ def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, ring_idx, key,
 
 
 def _stream_decode(cfg, params, cache, first, lengths, kv_start, prev_ring,
-                   key, gen: GenerationConfig, streamer):
+                   key, gen: GenerationConfig, streamer, host_embed=None):
+    """Python-driven decode loop: one host sync per token.  Used for token
+    streaming AND for the streamed >HBM-vocab embedding (reference
+    embedding.py:96 DiskEmbedding) — ``host_embed`` [V, H] lives in host
+    RAM (or a memmap); each step gathers only the current tokens' rows and
+    ships [B, 1, H] to the device, never the table."""
     b = first.shape[0]
     eos_set = set(gen.eos_token_id)
     out = np.full((b, gen.max_new_tokens), gen.pad_token_id, np.int32)
     out[:, 0] = np.asarray(first)
-    streamer(out[:, 0])
+    if streamer is not None:
+        streamer(out[:, 0])
     done = np.array([int(t) in eos_set for t in out[:, 0]])
     tok = first
     step = 1
     while step < gen.max_new_tokens and not done.all():
         pos = lengths + step - 1
+        emb = None
+        if host_embed is not None:
+            emb = jnp.asarray(
+                host_embed[np.asarray(tok)][:, None, :], jnp.float32)
         tok, cache, key, prev_ring = _decode_one(
             cfg, params, cache, tok, pos, kv_start, prev_ring,
             (lengths + step) % REP_WINDOW, key, gen,
             lengths=lengths if cfg.rope_2d else None,
+            input_embeds=emb,
         )
         row = np.asarray(tok)
         row = np.where(done, gen.pad_token_id, row)
         out[:, step] = row
-        streamer(row)
+        if streamer is not None:
+            streamer(row)
         done |= np.isin(row, list(eos_set)) if eos_set else False
         tok = jnp.asarray(row)
         step += 1

@@ -525,7 +525,6 @@ def decoder_forward(
     from ipex_llm_tpu.ops.embedding import embed_lookup
 
     b, t = tokens.shape
-    embed = params["embed"]
     x, cos, sin = embed_prelude(cfg, params, tokens, rope_positions,
                                 input_embeds)
     cos_l, sin_l = local_rope_tables(cfg, params, rope_positions)

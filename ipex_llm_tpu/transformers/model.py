@@ -59,6 +59,9 @@ class TPUModelForCausalLM:
         self.params = params
         self.qtype = qtype
         self.mesh = None  # set by .shard(mesh) for SPMD inference
+        # host-RAM [V, H] table for the streamed >HBM-vocab embedding
+        # (from_pretrained(disk_embedding=True)); None = table in HBM
+        self.streamed_embed = None
         # BenchmarkWrapper-compatible timing attributes
         self.first_cost: float | None = None
         self.rest_cost_mean: float | None = None
@@ -89,13 +92,16 @@ class TPUModelForCausalLM:
         mesh = kwargs.pop("mesh", None)
         speculative = kwargs.pop("speculative", False)
         embedding_qtype = kwargs.pop("embedding_qtype", None)
-        # the reference offloads the table to host/disk to save GPU memory
-        # (embedding.py:58,96); the TPU lever is HBM, so these flags map to
-        # the quantized-in-HBM table (in-jit row dequant, no host sync)
-        if kwargs.pop("cpu_embedding", False) or kwargs.pop(
-            "disk_embedding", False
-        ):
+        # reference embedding.py:58 CpuEmbedding: the TPU lever is HBM, so
+        # cpu_embedding maps to the quantized-in-HBM table (in-jit row
+        # dequant, no host sync)
+        if kwargs.pop("cpu_embedding", False):
             embedding_qtype = embedding_qtype or "sym_int8"
+        # reference embedding.py:96 DiskEmbedding: a vocab table too big
+        # even for HBM stays in HOST RAM; generate gathers only the current
+        # tokens' rows per step and ships [B,1,H] over PCIe (decode then
+        # runs the python-driven loop — see generation._stream_decode)
+        disk_embedding = kwargs.pop("disk_embedding", False)
         kwargs.pop("optimize_model", True)
         kwargs.pop("torch_dtype", None)
         kwargs.pop("trust_remote_code", None)
@@ -167,6 +173,20 @@ class TPUModelForCausalLM:
             imatrix_data=imatrix_data,
         )
         model = cls(cfg, params, hf_config, qtype)
+        if disk_embedding:
+            if "lm_head" not in params:
+                raise NotImplementedError(
+                    "disk_embedding needs an untied lm_head (tied logits "
+                    "read the embed table on-device every step)")
+            import numpy as np
+
+            from ipex_llm_tpu.quantize.core import QTensor
+            from ipex_llm_tpu.quantize import dequantize
+
+            emb = params.pop("embed")
+            model.streamed_embed = np.asarray(
+                dequantize(emb) if isinstance(emb, QTensor)
+                else emb, np.float32)
         if speculative:
             # reference model.py:366-376: draft = sym_int4 copy of the same
             # checkpoint (no separate draft weights)
@@ -210,9 +230,18 @@ class TPUModelForCausalLM:
         reference instead dequantizes k-quants to fp16/fp32 on CPU.
         """
         from ipex_llm_tpu.gguf import load_gguf_model
+        from ipex_llm_tpu.gguf.api import is_yuan_gguf, load_gguf_yuan
 
-        cfg, params, hf_config = load_gguf_model(fpath)
-        model = cls(cfg, params, hf_config, qtype="gguf")
+        if is_yuan_gguf(fpath):
+            # yuan-2 rides arch "llama" but needs the convattn decoder
+            # (reference gguf/api.py:54 -> gguf/models/yuan2.py)
+            from ipex_llm_tpu.models.convattn import TPUYuanForCausalLM
+
+            ycfg, yparams, yhf = load_gguf_yuan(fpath)
+            model = TPUYuanForCausalLM(ycfg, yparams, yhf, "gguf")
+        else:
+            cfg, params, hf_config = load_gguf_model(fpath)
+            model = cls(cfg, params, hf_config, qtype="gguf")
         # the reference returns (model, tokenizer); a GGUF-embedded
         # tokenizer needs no files on disk when transformers has gguf support
         tokenizer = None
@@ -277,8 +306,12 @@ class TPUModelForCausalLM:
 
                 cache = shard_cache(cache, self.mesh)
                 (tokens_j,) = shard_batch(self.mesh, b, tokens_j)
+            emb = None
+            if self.streamed_embed is not None:
+                emb = jnp.asarray(self.streamed_embed[tokens], jnp.float32)
             logits, _ = decoder_forward(
-                self.config, self.params, tokens_j, cache, pos
+                self.config, self.params, tokens_j, cache, pos,
+                input_embeds=emb,
             )
         return logits
 
@@ -309,6 +342,7 @@ class TPUModelForCausalLM:
         # table) and the merged generation config (custom eos/penalties
         # survive); _spec_generate re-wraps torch outputs itself.
         if (os.environ.get("IPEX_LLM_PERFORMANCE_MODE") == "1"
+                and self.streamed_embed is None
                 and len(rows) == 1 and len(rows[0]) >= 512
                 and streamer is None and not gcfg.do_sample
                 and self.mesh is None):
@@ -326,7 +360,7 @@ class TPUModelForCausalLM:
 
         res = generate(
             self.config, self.params, rows, gcfg, streamer=stream_cb,
-            mesh=self.mesh,
+            mesh=self.mesh, host_embed=self.streamed_embed,
         )
         if streamer is not None and hasattr(streamer, "end"):
             streamer.end()
@@ -363,6 +397,12 @@ class TPUModelForCausalLM:
 
     def _spec_generate(self, input_ids, draft_model, k, lookup, ngram, kwargs):
         from ipex_llm_tpu.speculative import speculative_generate as _spec
+
+        if self.streamed_embed is not None:
+            # the speculative driver's jitted draft/verify loops cannot
+            # host-gather the streamed table per token
+            raise NotImplementedError(
+                "disk_embedding models support plain generate() only")
 
         was_torch = _is_torch(input_ids)
         tokens = np.asarray(_to_numpy(input_ids), np.int32)

@@ -53,3 +53,65 @@ def test_model_with_quantized_embedding(tmp_path):
     assert np.abs(got - want).max() / scale < 0.08
     agree = (got.argmax(-1) == want.argmax(-1)).mean()
     assert agree > 0.85
+
+
+def test_disk_embedding_streams_from_host(tmp_path):
+    """disk_embedding=True (reference embedding.py:96 DiskEmbedding): the
+    table lives in HOST RAM, params carry no embed leaf, and generate runs
+    the python-driven decode with per-step row gathers — logits and greedy
+    tokens match the in-HBM model."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=192, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    hf = LlamaForCausalLM(cfg).eval()
+    hf.save_pretrained(str(tmp_path / "m"), safe_serialization=True)
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    m_dense = AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "m"), load_in_low_bit="bf16")
+    m_disk = AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "m"), load_in_low_bit="bf16", disk_embedding=True)
+
+    assert "embed" not in m_disk.params
+    assert m_disk.streamed_embed is not None
+    assert m_disk.streamed_embed.shape == (192, 32)
+
+    tokens = RNG.integers(0, 192, (2, 9)).astype(np.int32)
+    want = np.asarray(m_dense(tokens))
+    got = np.asarray(m_disk(tokens))
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+    prompt = tokens[0].tolist()
+    w = np.asarray(m_dense.generate(np.asarray([prompt], np.int32),
+                                    max_new_tokens=6, do_sample=False))
+    g = np.asarray(m_disk.generate(np.asarray([prompt], np.int32),
+                                   max_new_tokens=6, do_sample=False))
+    np.testing.assert_array_equal(g[0, :len(prompt) + 4],
+                                  w[0, :len(prompt) + 4])
+
+
+def test_disk_embedding_requires_untied_head(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(4)
+    LlamaForCausalLM(cfg).eval().save_pretrained(
+        str(tmp_path / "tied"), safe_serialization=True)
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    with pytest.raises(NotImplementedError):
+        AutoModelForCausalLM.from_pretrained(
+            str(tmp_path / "tied"), load_in_low_bit="bf16",
+            disk_embedding=True)
