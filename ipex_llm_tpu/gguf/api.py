@@ -26,9 +26,12 @@ from ipex_llm_tpu.quantize.core import QTensor
 
 NORM_DTYPE = jnp.float32
 
-#: architectures sharing the llama-style GGUF tensor naming
+#: architectures sharing the llama-style GGUF tensor naming (baichuan-7B
+#: rides its own arch key but identical tensor names, reference
+#: gguf/models/baichuan.py; mixtral arrives as arch "llama" with
+#: llama.expert_count metadata, reference gguf/api.py:47)
 _SUPPORTED_ARCH = ("llama", "mistral", "qwen2", "qwen3", "phi3", "gemma",
-                   "gemma2", "starcoder2", "internlm2")
+                   "gemma2", "starcoder2", "internlm2", "baichuan")
 #: fused-qkv, non-gated-MLP architectures (llama.cpp's converters normalize
 #: attn_qkv to the standard [q_all; k; v] concat, so no re-interleave here)
 _FUSED_ARCH = ("falcon", "bloom", "mpt", "gpt2")
@@ -92,6 +95,13 @@ def _meta_config(rd: GGUFReader) -> ModelConfig:
     hidden = int(g("embedding_length"))
     heads = int(g("attention.head_count"))
     head_dim = int(g("attention.key_length", hidden // heads))
+    if arch == "baichuan" and hidden > 4096:
+        # baichuan-13B uses ALiBi, not rope (families.py gates the HF path
+        # on the same hidden-size marker); loading it through the rope
+        # config would silently emit garbage
+        raise NotImplementedError(
+            "baichuan-13B GGUF (ALiBi) is not supported; baichuan-7B "
+            "(rope) loads fine")
     vocab = rd.tensors["token_embd.weight"].shape[0]
     rope_base = float(g("rope.freq_base", 10000.0))
     rs = RopeScaling(
@@ -100,11 +110,24 @@ def _meta_config(rd: GGUFReader) -> ModelConfig:
         kind="linear" if g("rope.scale_linear") else "default",
         factor=float(g("rope.scale_linear", 1.0)),
     )
+    ffn = int(g("feed_forward_length"))
+    moe: dict = {}
+    n_experts = int(g("expert_count", 0) or 0)
+    if n_experts:
+        # mixtral-style MoE GGUF (reference gguf/models/mixtral.py): top-k
+        # router logits then softmax over the k
+        moe = dict(
+            model_type="mixtral",
+            num_experts=n_experts,
+            num_experts_per_tok=int(g("expert_used_count", 2)),
+            moe_intermediate_size=ffn,
+            moe_softmax_before_topk=False,
+        )
     return ModelConfig(
-        model_type=str(arch),
+        model_type=moe.pop("model_type", str(arch)),
         vocab_size=int(vocab),
         hidden_size=hidden,
-        intermediate_size=int(g("feed_forward_length")),
+        intermediate_size=ffn,
         num_layers=int(g("block_count")),
         num_heads=heads,
         num_kv_heads=int(g("attention.head_count_kv", heads)),
@@ -115,6 +138,7 @@ def _meta_config(rd: GGUFReader) -> ModelConfig:
         qk_norm=f"blk.0.attn_q_norm.weight" in rd.tensors,
         tie_word_embeddings="output.weight" not in rd.tensors,
         attention_bias="blk.0.attn_q.bias" in rd.tensors,
+        **moe,
     )
 
 
@@ -144,9 +168,150 @@ def _load_qtensor(rd: GGUFReader, name: str) -> QTensor:
     return gconv.to_qtensor(rd.raw(name), info.shape, rd.astype_name(name))
 
 
+def _requant_qtype(src: str) -> str:
+    """Requantization target preserving the source's bit budget: <=4.5-bit
+    ggml blocks land in sym_int4, everything else in sym_int8."""
+    return "sym_int4" if src in ("q4_0", "q4_1", "q2_k", "q3_k",
+                                 "q4_k") else "sym_int8"
+
+
+def _expert_dense(rd: GGUFReader, i: int, stem: str, e: int,
+                  n_e: int) -> tuple[np.ndarray, str]:
+    """One expert's dense [out, in] weight from either the legacy
+    per-expert tensors (blk.i.ffn_gate.E.weight) or the merged 3-D
+    blk.i.ffn_gate_exps.weight layout (equal-size block slices)."""
+    name = f"blk.{i}.{stem}.{e}.weight"
+    if name in rd.tensors:
+        info = rd.tensors[name]
+        t = rd.astype_name(name)
+        return gconv.to_dense(rd.raw(name), info.shape, t), t
+    merged = f"blk.{i}.{stem}_exps.weight"
+    info = rd.tensors[merged]
+    t = rd.astype_name(merged)
+    raw = rd.raw(merged)
+    per = raw.size // n_e
+    sub = raw[e * per:(e + 1) * per]
+    return gconv.to_dense(sub, tuple(info.shape[1:]), t), t
+
+
+def _load_moe_layer(rd: GGUFReader, i: int, cfg: ModelConfig,
+                    lp: dict) -> None:
+    """Router + stacked per-expert QTensors for a mixtral-style GGUF layer
+    (reference gguf/models/mixtral.py).  Expert blocks are dequantized and
+    requantized at matching bit budget because gate/up fuse into one
+    [2*ffn, h] tensor per expert (the scan decoder's MoE layout)."""
+    router = gconv.to_dense(
+        rd.raw(f"blk.{i}.ffn_gate_inp.weight"),
+        (cfg.num_experts, cfg.hidden_size),
+        rd.astype_name(f"blk.{i}.ffn_gate_inp.weight"))
+    lp["router"] = jnp.asarray(np.ascontiguousarray(router.T), jnp.float32)
+    e_gu, e_down = [], []
+    for e in range(cfg.num_experts):
+        gw, t = _expert_dense(rd, i, "ffn_gate", e, cfg.num_experts)
+        uw, _ = _expert_dense(rd, i, "ffn_up", e, cfg.num_experts)
+        dw, _ = _expert_dense(rd, i, "ffn_down", e, cfg.num_experts)
+        rq = _requant_qtype(t)
+        # quantize takes [in, out]; expert tensors arrive HF-layout [out, in]
+        e_gu.append(qcore.quantize(
+            np.ascontiguousarray(np.concatenate([gw, uw], 0).T), rq))
+        e_down.append(qcore.quantize(np.ascontiguousarray(dw.T), rq))
+    lp["moe_gate_up"] = stack_layer_trees(e_gu)
+    lp["moe_down"] = stack_layer_trees(e_down)
+
+
 def _requantize(qt: QTensor, qtype: str) -> QTensor:
     w = qcore.dequantize(qt)  # [in, out]
     return qcore.quantize(np.asarray(w), qtype)
+
+
+def is_yuan_gguf(path: str) -> bool:
+    """Yuan-2 rides arch "llama" in GGUF (reference gguf/api.py:54 branches
+    on general.name); the LF-gate conv tensors are the robust marker."""
+    rd = GGUFReader(path)
+    try:
+        return ("blk.0.conv1.weight" in rd.tensors
+                or "yuan" in str(rd.metadata.get("general.name", "")).lower())
+    finally:
+        rd.close()
+
+
+def load_gguf_yuan(path: str):
+    """Yuan-2 GGUF -> (YuanConfig, params, hf_config) for the convattn
+    decoder (reference gguf/models/yuan2.py maps the same tensor names onto
+    its patched HF Yuan model)."""
+    from ipex_llm_tpu.models.convattn import YuanConfig, build_yuan_params
+
+    rd = GGUFReader(path)
+    md = rd.metadata
+
+    def g(key: str, default=None):
+        return md.get(f"llama.{key}", default)
+
+    hf = {
+        "vocab_size": int(rd.tensors["token_embd.weight"].shape[0]),
+        "hidden_size": int(g("embedding_length")),
+        "intermediate_size": int(g("feed_forward_length")),
+        "num_hidden_layers": int(g("block_count")),
+        "num_attention_heads": int(g("attention.head_count")),
+        "rms_norm_eps": float(g("attention.layer_norm_rms_epsilon", 1e-6)),
+        "rope_theta": float(g("rope.freq_base", 10000.0)),
+        "max_position_embeddings": int(g("context_length", 4096)),
+        "eos_token_id": int(md.get("tokenizer.ggml.eos_token_id", 77185)),
+    }
+    cfg = YuanConfig.from_hf(hf)
+
+    _MAP = {
+        "self_attn.q_proj.weight": "attn_q.weight",
+        "self_attn.k_proj.weight": "attn_k.weight",
+        "self_attn.v_proj.weight": "attn_v.weight",
+        "self_attn.o_proj.weight": "attn_output.weight",
+        "mlp.gate_proj.weight": "ffn_gate.weight",
+        "mlp.up_proj.weight": "ffn_up.weight",
+        "mlp.down_proj.weight": "ffn_down.weight",
+        "input_layernorm.weight": "attn_norm.weight",
+        "post_attention_layernorm.weight": "ffn_norm.weight",
+        "self_attn.lf_gate.output_layernorm.weight": "lf_output_norm.weight",
+        "self_attn.lf_gate.output_layernorm.bias": "lf_output_norm.bias",
+        "self_attn.lf_gate.conv1.weight": "conv1.weight",
+        "self_attn.lf_gate.conv2.weight": "conv2.weight",
+        "self_attn.lf_gate.conv1.bias": "conv1.bias",
+        "self_attn.lf_gate.conv2.bias": "conv2.bias",
+    }
+    _TOP = {
+        "model.embed_tokens.weight": "token_embd.weight",
+        "model.norm.weight": "output_norm.weight",
+        "lm_head.weight": "output.weight",
+    }
+
+    def to_gguf_name(hf_name: str) -> str | None:
+        if hf_name in _TOP:
+            return _TOP[hf_name]
+        if hf_name.startswith("model.layers."):
+            rest = hf_name.split(".", 2)[2]
+            i, suffix = rest.split(".", 1)
+            if suffix in _MAP:
+                return f"blk.{i}.{_MAP[suffix]}"
+        return None
+
+    def get(hf_name):
+        name = to_gguf_name(hf_name)
+        info = rd.tensors[name]
+        return gconv.to_dense(rd.raw(name), info.shape, rd.astype_name(name))
+
+    def has(hf_name):
+        name = to_gguf_name(hf_name)
+        return name is not None and name in rd.tensors
+
+    qtype = _requant_qtype(rd.astype_name("blk.0.attn_q.weight"))
+    params = build_yuan_params(cfg, get, has, qtype)
+    hf_config = {
+        "model_type": "yuan",
+        "vocab_size": cfg.vocab_size,
+        "eos_token_id": cfg.eos_token_id,
+        "_gguf_source": path,
+    }
+    rd.close()
+    return cfg, params, hf_config
 
 
 def load_gguf_model(path: str) -> tuple[ModelConfig, dict[str, Any], dict]:
@@ -180,7 +345,13 @@ def load_gguf_model(path: str) -> tuple[ModelConfig, dict[str, Any], dict]:
                 name = f"blk.{i}.{stem}.weight"
                 if name in rd.tensors:
                     lp[key] = dense(name)
-        for key, stem in slots.items():
+        this_slots = dict(slots)
+        if cfg.layer_is_moe(i):
+            # mixtral-style MoE layer: experts replace the dense FFN slots
+            for s in ("gate", "up", "down"):
+                this_slots.pop(s, None)
+            _load_moe_layer(rd, i, cfg, lp)
+        for key, stem in this_slots.items():
             name = f"blk.{i}.{stem}.weight"
             lp[key] = _load_qtensor(rd, name)
             bias = f"blk.{i}.{stem}.bias"
@@ -190,6 +361,8 @@ def load_gguf_model(path: str) -> tuple[ModelConfig, dict[str, Any], dict]:
 
     # homogenize per-slot qtypes across layers (scan needs one layout)
     for key in slots:
+        if key not in layers[0]:
+            continue  # MoE models carry expert stacks instead
         qtypes_seen = {layers[i][key].qtype for i in range(cfg.num_layers)}
         if len(qtypes_seen) > 1:
             for i in range(cfg.num_layers):

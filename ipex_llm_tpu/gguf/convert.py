@@ -153,4 +153,12 @@ def to_qtensor(raw: np.ndarray, shape: tuple[int, ...], type_name: str) -> QTens
         return _CONVERTERS[type_name](raw, out, n_in)
     if type_name in _KQUANTS:
         return _kquant(raw, out, n_in, type_name, _KQUANTS[type_name])
-    raise NotImplementedError(f"ggml type {type_name} import")
+    supported = sorted(("fp32", "fp16", "bf16", *_CONVERTERS, *_KQUANTS))
+    raise NotImplementedError(
+        f"ggml tensor type {type_name!r} cannot be imported; supported GGUF "
+        f"tensor formats: {', '.join(supported)}.  iq-family blocks "
+        "(iq2_xxs/iq2_xs/iq1_s/...) use llama.cpp codebook lattices that "
+        "this importer does not decode — requantize the file with "
+        "`llama-quantize --allow-requantize` to a k-quant (q4_k/q6_k) "
+        "first.  (The TPU-native iq2/iq1 codecs in quantize/core.py are a "
+        "separate on-load format, not a GGUF block decoder.)")

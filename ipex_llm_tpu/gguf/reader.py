@@ -42,13 +42,27 @@ GGML_TYPE_LAYOUT = {
     13: (256, 176),  # Q5_K
     14: (256, 210),  # Q6_K
     15: (256, 292),  # Q8_K
+    # iq family: PARSED (header walk must not die on one tensor) but not
+    # decodable — convert.to_qtensor raises a clear error naming the
+    # supported set (llama.cpp codebook lattices, see convert.py)
+    16: (256, 66),   # IQ2_XXS
+    17: (256, 74),   # IQ2_XS
+    18: (256, 98),   # IQ3_XXS
+    19: (256, 50),   # IQ1_S
+    20: (32, 18),    # IQ4_NL
+    21: (256, 110),  # IQ3_S
+    22: (256, 82),   # IQ2_S
+    23: (256, 136),  # IQ4_XS
+    29: (256, 56),   # IQ1_M
     30: (1, 2),     # BF16
 }
 
 GGML_TYPE_NAME = {
     0: "fp32", 1: "fp16", 2: "q4_0", 3: "q4_1", 6: "q5_0", 7: "q5_1",
     8: "q8_0", 10: "q2_k", 11: "q3_k", 12: "q4_k", 13: "q5_k", 14: "q6_k",
-    15: "q8_k", 30: "bf16",
+    15: "q8_k", 16: "iq2_xxs", 17: "iq2_xs", 18: "iq3_xxs", 19: "iq1_s",
+    20: "iq4_nl", 21: "iq3_s", 22: "iq2_s", 23: "iq4_xs", 29: "iq1_m",
+    30: "bf16",
 }
 
 

@@ -430,3 +430,255 @@ def test_from_gguf_falcon(tmp_path):
         want = hf(torch.from_numpy(tokens).long()).logits.float().numpy()
     got = _run_gguf(p, tokens)
     assert np.abs(got - want).max() / np.abs(want).max() < 0.06
+
+
+# ---------------------------------------------------------------------------
+# arch tail: mixtral / baichuan / yuan2 + iq-format error (VERDICT r4 #6)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_mixtral():
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    cfg = MixtralConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+    )
+    torch.manual_seed(5)
+    return MixtralForCausalLM(cfg).eval()
+
+
+def _export_mixtral_gguf(model, path, merged=False):
+    """llama.cpp stores mixtral under arch 'llama' + llama.expert_count;
+    experts either per-tensor (legacy) or merged [E, out, in] *_exps."""
+    sd = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    c = model.config
+    meta = {
+        "general.architecture": "llama",
+        "general.name": "mixtral-tiny",
+        "llama.block_count": c.num_hidden_layers,
+        "llama.embedding_length": c.hidden_size,
+        "llama.feed_forward_length": c.intermediate_size,
+        "llama.attention.head_count": c.num_attention_heads,
+        "llama.attention.head_count_kv": c.num_key_value_heads,
+        "llama.attention.layer_norm_rms_epsilon": float(c.rms_norm_eps),
+        "llama.rope.freq_base": float(c.rope_theta),
+        "llama.context_length": c.max_position_embeddings,
+        "llama.expert_count": c.num_local_experts,
+        "llama.expert_used_count": c.num_experts_per_tok,
+    }
+    tensors = {
+        "token_embd.weight": (sd["model.embed_tokens.weight"], "f16"),
+        "output_norm.weight": (sd["model.norm.weight"], "f32"),
+        "output.weight": (sd["lm_head.weight"], "q8_0"),
+    }
+    attn = {"attn_q": "q_proj", "attn_k": "k_proj", "attn_v": "v_proj",
+            "attn_output": "o_proj"}
+    for i in range(c.num_hidden_layers):
+        lp = f"model.layers.{i}."
+        tensors[f"blk.{i}.attn_norm.weight"] = (
+            sd[lp + "input_layernorm.weight"], "f32")
+        tensors[f"blk.{i}.ffn_norm.weight"] = (
+            sd[lp + "post_attention_layernorm.weight"], "f32")
+        for g, h in attn.items():
+            tensors[f"blk.{i}.{g}.weight"] = (
+                sd[lp + f"self_attn.{h}.weight"], "q8_0")
+        tensors[f"blk.{i}.ffn_gate_inp.weight"] = (
+            sd[lp + "block_sparse_moe.gate.weight"], "f32")
+        emap = {"ffn_gate": "w1", "ffn_up": "w3", "ffn_down": "w2"}
+        for g, w in emap.items():
+            es = [sd[lp + f"block_sparse_moe.experts.{e}.{w}.weight"]
+                  for e in range(c.num_local_experts)]
+            if merged:
+                tensors[f"blk.{i}.{g}_exps.weight"] = (np.stack(es), "f16")
+            else:
+                for e, arr in enumerate(es):
+                    tensors[f"blk.{i}.{g}.{e}.weight"] = (arr, "q8_0")
+    write_gguf(path, meta, tensors)
+
+
+@pytest.mark.parametrize("merged", [False, True])
+def test_gguf_mixtral(tmp_path, merged):
+    torch = pytest.importorskip("torch")
+    hf = _tiny_mixtral()
+    p = str(tmp_path / f"mix{merged}.gguf")
+    _export_mixtral_gguf(hf, p, merged=merged)
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    model, _tok = AutoModelForCausalLM.from_gguf(p)
+    assert model.config.model_type == "mixtral"
+    assert model.config.num_experts == 4
+    tokens = np.random.default_rng(2).integers(0, 160, (1, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens).long()).logits.float().numpy()
+    got = np.asarray(model(tokens))
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 0.15
+    assert (got.argmax(-1) == want.argmax(-1)).mean() > 0.8
+
+
+def test_gguf_baichuan(tmp_path, tiny_hf):
+    """baichuan-7B GGUF: own arch key, llama tensor names
+    (reference gguf/models/baichuan.py builds a Llama model from it)."""
+    torch = pytest.importorskip("torch")
+    p = str(tmp_path / "bc.gguf")
+    sd = {k: v.float().numpy() for k, v in tiny_hf.state_dict().items()}
+    c = tiny_hf.config
+    meta = {
+        "general.architecture": "baichuan",
+        "baichuan.block_count": c.num_hidden_layers,
+        "baichuan.embedding_length": c.hidden_size,
+        "baichuan.feed_forward_length": c.intermediate_size,
+        "baichuan.attention.head_count": c.num_attention_heads,
+        "baichuan.attention.head_count_kv": c.num_key_value_heads,
+        "baichuan.attention.layer_norm_rms_epsilon": float(c.rms_norm_eps),
+        "baichuan.rope.freq_base": float(c.rope_theta),
+        "baichuan.context_length": c.max_position_embeddings,
+    }
+    tensors = {
+        "token_embd.weight": (sd["model.embed_tokens.weight"], "f16"),
+        "output_norm.weight": (sd["model.norm.weight"], "f32"),
+        "output.weight": (sd["lm_head.weight"], "q8_0"),
+    }
+    slot = {
+        "attn_q": "self_attn.q_proj", "attn_k": "self_attn.k_proj",
+        "attn_v": "self_attn.v_proj", "attn_output": "self_attn.o_proj",
+        "ffn_gate": "mlp.gate_proj", "ffn_up": "mlp.up_proj",
+        "ffn_down": "mlp.down_proj",
+    }
+    for i in range(c.num_hidden_layers):
+        tensors[f"blk.{i}.attn_norm.weight"] = (
+            sd[f"model.layers.{i}.input_layernorm.weight"], "f32")
+        tensors[f"blk.{i}.ffn_norm.weight"] = (
+            sd[f"model.layers.{i}.post_attention_layernorm.weight"], "f32")
+        for g, h in slot.items():
+            tensors[f"blk.{i}.{g}.weight"] = (
+                sd[f"model.layers.{i}.{h}.weight"], "q8_0")
+    write_gguf(p, meta, tensors)
+
+    from ipex_llm_tpu.gguf import load_gguf_model
+
+    cfg, params, hf_config = load_gguf_model(p)
+    assert cfg.model_type == "baichuan"
+    tokens = np.random.default_rng(3).integers(0, 160, (1, 9)).astype(np.int32)
+    with torch.no_grad():
+        want = tiny_hf(torch.from_numpy(tokens).long()).logits.float().numpy()
+    from ipex_llm_tpu.kv import KVCache
+    from ipex_llm_tpu.models.decoder import decoder_forward
+    import jax.numpy as jnp
+
+    cache = KVCache.init(cfg.num_layers, 1, 9, cfg.num_kv_heads, cfg.head_dim)
+    got = np.asarray(decoder_forward(
+        cfg, params, jnp.asarray(tokens), cache, jnp.arange(9)[None, :])[0])
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.05
+
+
+def test_gguf_yuan(tmp_path):
+    """yuan2 GGUF (arch llama + conv tensors) roundtrips onto the convattn
+    decoder (reference gguf/models/yuan2.py)."""
+    rng = np.random.default_rng(9)
+    from tests.test_families6 import _yuan_random_model
+
+    model = _yuan_random_model(rng)
+    sd_names = {
+        "attn_q": "self_attn.q_proj", "attn_k": "self_attn.k_proj",
+        "attn_v": "self_attn.v_proj", "attn_output": "self_attn.o_proj",
+        "ffn_gate": "mlp.gate_proj", "ffn_up": "mlp.up_proj",
+        "ffn_down": "mlp.down_proj",
+    }
+    # regenerate the same random state dict the model was built from
+    rng2 = np.random.default_rng(9)
+    from tests.test_families6 import _rand_sd_llama_like
+
+    sd = _rand_sd_llama_like(rng2, nkv=4)
+    for i in range(2):
+        p_ = f"model.layers.{i}.self_attn.lf_gate."
+        sd[p_ + "conv1.weight"] = (
+            rng2.standard_normal((32, 64, 2, 1)).astype(np.float32) * 0.1)
+        sd[p_ + "conv1.bias"] = rng2.standard_normal(32).astype(np.float32) * 0.1
+        sd[p_ + "conv2.weight"] = (
+            rng2.standard_normal((64, 32, 2, 1)).astype(np.float32) * 0.1)
+        sd[p_ + "conv2.bias"] = rng2.standard_normal(64).astype(np.float32) * 0.1
+        sd[p_ + "output_layernorm.weight"] = np.ones((64,), np.float32)
+        sd[p_ + "output_layernorm.bias"] = np.zeros((64,), np.float32)
+
+    meta = {
+        "general.architecture": "llama",
+        "general.name": "Yuan2-tiny",
+        "llama.block_count": 2, "llama.embedding_length": 64,
+        "llama.feed_forward_length": 128, "llama.attention.head_count": 4,
+        "llama.attention.layer_norm_rms_epsilon": 1e-6,
+        "llama.rope.freq_base": 10000.0, "llama.context_length": 256,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    tensors = {
+        "token_embd.weight": (sd["model.embed_tokens.weight"], "f32"),
+        "output_norm.weight": (sd["model.norm.weight"], "f32"),
+        "output.weight": (sd["lm_head.weight"], "f32"),
+    }
+    for i in range(2):
+        lp = f"model.layers.{i}."
+        tensors[f"blk.{i}.attn_norm.weight"] = (
+            sd[lp + "input_layernorm.weight"], "f32")
+        tensors[f"blk.{i}.ffn_norm.weight"] = (
+            sd[lp + "post_attention_layernorm.weight"], "f32")
+        for g, h in sd_names.items():
+            tensors[f"blk.{i}.{g}.weight"] = (sd[lp + h + ".weight"], "f32")
+        gp = lp + "self_attn.lf_gate."
+        tensors[f"blk.{i}.lf_output_norm.weight"] = (
+            sd[gp + "output_layernorm.weight"], "f32")
+        tensors[f"blk.{i}.lf_output_norm.bias"] = (
+            sd[gp + "output_layernorm.bias"], "f32")
+        tensors[f"blk.{i}.conv1.weight"] = (sd[gp + "conv1.weight"], "f32")
+        tensors[f"blk.{i}.conv2.weight"] = (sd[gp + "conv2.weight"], "f32")
+        tensors[f"blk.{i}.conv1.bias"] = (sd[gp + "conv1.bias"], "f32")
+        tensors[f"blk.{i}.conv2.bias"] = (sd[gp + "conv2.bias"], "f32")
+    p = str(tmp_path / "yuan.gguf")
+    write_gguf(p, meta, tensors)
+
+    from ipex_llm_tpu.transformers import AutoModelForCausalLM
+
+    gmodel, _tok = AutoModelForCausalLM.from_gguf(p)
+    from ipex_llm_tpu.models.convattn import TPUYuanForCausalLM
+
+    assert isinstance(gmodel, TPUYuanForCausalLM)
+    tokens = np.random.default_rng(4).integers(0, 150, (1, 8)).astype(np.int32)
+    want = np.asarray(model(tokens))
+    got = np.asarray(gmodel(tokens))
+    # gguf path requantizes (f32 source -> sym_int8); allow quant drift
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.08
+
+
+def test_gguf_iq_block_clear_error(tmp_path):
+    """A file holding iq2_xxs blocks fails with an actionable message naming
+    the supported formats (VERDICT r4 missing #3)."""
+    import struct as _st
+
+    w = np.zeros((2, 256), np.float32)
+    p = str(tmp_path / "iq.gguf")
+    write_gguf(p, {"general.architecture": "llama"},
+               {"w.weight": (w, "f32")})
+    # rewrite the tensor's type id to IQ2_XXS (16) in the header
+    raw = bytearray(open(p, "rb").read())
+    idx = raw.find(b"w.weight")
+    # name(8B str + len prefix) + ndims(4) + 2 dims(16) -> type id offset
+    toff = idx + 8 + 4 + 16
+    _st.pack_into("<I", raw, toff, 16)
+    open(p, "wb").write(bytes(raw))
+
+    from ipex_llm_tpu.gguf.reader import GGUFReader
+
+    rd = GGUFReader(p)
+    assert rd.astype_name("w.weight") == "iq2_xxs"
+    from ipex_llm_tpu.gguf import convert as gconv
+
+    with pytest.raises(NotImplementedError) as ei:
+        gconv.to_qtensor(rd.raw("w.weight"), (2, 256), "iq2_xxs")
+    msg = str(ei.value)
+    assert "q4_k" in msg and "llama-quantize" in msg
+    # (skip rd.close(): the raised path leaves a live zero-copy view of the
+    # mmap in the traceback; the handle dies with the test)
