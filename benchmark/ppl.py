@@ -58,11 +58,7 @@ def builtin_tokens(tokenizer=None, n_tokens: int = 4096):
 def _window_nll(cfg, params, window: np.ndarray, score_from: int,
                 kv_kind: str = "normal"):
     """Sum NLL (nats) + token count over window[score_from:]."""
-    import jax
     import jax.numpy as jnp
-
-    from ipex_llm_tpu.kv import make_cache
-    from ipex_llm_tpu.models.decoder import decoder_forward
 
     nll, n = _nll_jit()(cfg, params,
                         jnp.asarray(window[None, :], jnp.int32),
