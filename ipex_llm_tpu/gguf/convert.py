@@ -106,12 +106,101 @@ def _kquant(raw: np.ndarray, out: int, n_in: int, name: str,
     return QTensor(data, None, None, name, (n_in, out), 256)
 
 
+# --- k-quant EXACT repacks onto the fused-kernel planes ---------------------
+# q4_k/q5_k/q6_k (the formats real GGUF ships overwhelmingly use) repack
+# bit-exactly into the formats the Pallas dequant-matmul fuses (VERDICT r4
+# next #5): the 6-bit sub-scales fold into f32 scale/zero planes per 32- (or
+# 16-) block, codes land in the kernel's nibble/5-bit/byte layouts.  The
+# model then runs the fused hot loop instead of the XLA in-jit superblock
+# decode.  Cost: ~1.5 extra bits/weight of f32 scale planes vs the raw
+# superblocks — HBM for speed.  q2_k/q3_k/q8_k keep the raw-byte in-jit
+# path; IPEX_LLM_TPU_GGUF_RAW_KQUANTS=1 forces it for all k-quants.
+
+
+def _scale_min_k4_np(sb: np.ndarray, j: int):
+    """numpy twin of kquants._scale_min_k4: 6-bit (scale, min) pair j."""
+    if j < 4:
+        sc = sb[..., j] & 63
+        m = sb[..., j + 4] & 63
+    else:
+        sc = (sb[..., j + 4] & 0x0F) | ((sb[..., j - 4] >> 6) << 4)
+        m = (sb[..., j + 4] >> 4) | ((sb[..., j] >> 6) << 4)
+    return sc.astype(np.float32), m.astype(np.float32)
+
+
+def _q4_k_planes(raw: np.ndarray, out: int, n_in: int, with_high: bool):
+    """Shared q4_k/q5_k plane split: codes [out, in] + f32 scales/zeros
+    [in/32, out]."""
+    ts = 176 if with_high else 144
+    r = _blocks(raw, out, ts)
+    nb = n_in // 256
+    d = _f16(r[:, :, 0:2].copy().view(np.uint16)[:, :, 0])      # [out, nb]
+    dmin = _f16(r[:, :, 2:4].copy().view(np.uint16)[:, :, 0])
+    sb = r[:, :, 4:16]
+    qs = r[:, :, 48:176] if with_high else r[:, :, 16:144]      # [out,nb,128]
+    qh = r[:, :, 16:48] if with_high else None                  # [out,nb,32]
+    codes = np.empty((out, nb, 8, 32), np.uint8)
+    scales = np.empty((out, nb, 8), np.float32)
+    zeros = np.empty((out, nb, 8), np.float32)
+    for j in range(8):
+        grp = qs[:, :, (j // 2) * 32 : (j // 2) * 32 + 32]
+        q = (grp & 0x0F) if j % 2 == 0 else (grp >> 4)
+        if with_high:
+            q = q | (((qh >> j) & 1) << 4)
+        codes[:, :, j] = q
+        sc, m = _scale_min_k4_np(sb, j)
+        scales[:, :, j] = d * sc
+        zeros[:, :, j] = -dmin * m
+    return (codes.reshape(out, n_in),
+            scales.reshape(out, nb * 8).T.copy(),
+            zeros.reshape(out, nb * 8).T.copy())
+
+
+def _q4_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    codes, scales, zeros = _q4_k_planes(raw, out, n_in, with_high=False)
+    data = _pack_from_row_codes(codes, 32)
+    return QTensor(data, scales, zeros, "asym_int4", (n_in, out), 32)
+
+
+def _q5_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    from ipex_llm_tpu.quantize.core import _pack_5bit
+
+    codes, scales, zeros = _q4_k_planes(raw, out, n_in, with_high=True)
+    data = _pack_5bit(np.ascontiguousarray(codes.T), 32)
+    return QTensor(data, scales, zeros, "asym_int5", (n_in, out), 32)
+
+
+def _q6_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    """q6_k: 6-bit codes, signed int8 scale per 16 values.  Exact map onto
+    the kernel's byte-per-code path: c = q + 96 so (c - 128) = q - 32, with
+    f32 scales d*sc16 per 16-block ('sym_int8' semantics, block_size 16)."""
+    r = _blocks(raw, out, 210)
+    nb = n_in // 256
+    ql = r[:, :, 0:128]
+    qh = r[:, :, 128:192]
+    sc = r[:, :, 192:208].view(np.int8).astype(np.float32)      # [out,nb,16]
+    d = _f16(r[:, :, 208:210].copy().view(np.uint16)[:, :, 0])  # [out, nb]
+    codes = np.empty((out, nb, 2, 128), np.uint8)
+    for n in range(2):
+        lq = ql[:, :, n * 64 : n * 64 + 64]
+        hq = qh[:, :, n * 32 : n * 32 + 32]
+        codes[:, :, n, 0:32] = (lq[:, :, 0:32] & 0x0F) | (((hq >> 0) & 3) << 4)
+        codes[:, :, n, 32:64] = (lq[:, :, 32:64] & 0x0F) | (((hq >> 2) & 3) << 4)
+        codes[:, :, n, 64:96] = (lq[:, :, 0:32] >> 4) | (((hq >> 4) & 3) << 4)
+        codes[:, :, n, 96:128] = (lq[:, :, 32:64] >> 4) | (((hq >> 6) & 3) << 4)
+    data = (codes.reshape(out, n_in) + 96).astype(np.uint8).T.copy()
+    scales = (d[:, :, None] * sc).reshape(out, nb * 16).T.copy()
+    return QTensor(data, scales, None, "sym_int8", (n_in, out), 16)
+
+
 _CONVERTERS = {
     "q4_0": _q4_0, "q4_1": _q4_1, "q8_0": _q8_0,
     "q5_0": _q5_0, "q5_1": _q5_1,
 }
 _KQUANTS = {"q2_k": 84, "q3_k": 110, "q4_k": 144, "q5_k": 176, "q6_k": 210,
             "q8_k": 292}
+_KQUANT_REPACK = {"q4_k": _q4_k_repack, "q5_k": _q5_k_repack,
+                  "q6_k": _q6_k_repack}
 
 
 def to_dense(raw: np.ndarray, shape: tuple[int, ...], type_name: str) -> np.ndarray:
@@ -151,6 +240,11 @@ def to_qtensor(raw: np.ndarray, shape: tuple[int, ...], type_name: str) -> QTens
                        (n_in, out), 0)
     if type_name in _CONVERTERS:
         return _CONVERTERS[type_name](raw, out, n_in)
+    if type_name in _KQUANT_REPACK and n_in % 256 == 0:
+        import os
+
+        if os.environ.get("IPEX_LLM_TPU_GGUF_RAW_KQUANTS", "0") != "1":
+            return _KQUANT_REPACK[type_name](raw, out, n_in)
     if type_name in _KQUANTS:
         return _kquant(raw, out, n_in, type_name, _KQUANTS[type_name])
     supported = sorted(("fp32", "fp16", "bf16", *_CONVERTERS, *_KQUANTS))
