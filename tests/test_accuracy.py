@@ -116,3 +116,57 @@ def test_lmeval_adapter_generate_until(tiny_llama):
     outs = lm.generate_until([_Req("abc def", {"max_gen_toks": 12})])
     assert len(outs) == 1 and isinstance(outs[0], str)
     assert len(outs[0]) <= 12
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_with_tok(tmp_path_factory):
+    """Checkpoint WITH a real (char-level) tokenizer: the one-command
+    real-corpus protocol needs AutoTokenizer to load from the model dir."""
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import (LlamaConfig, LlamaForCausalLM,
+                              PreTrainedTokenizerFast)
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=1024, tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    path = str(tmp_path_factory.mktemp("tiny_llama_tok"))
+    LlamaForCausalLM(cfg).eval().save_pretrained(path,
+                                                 safe_serialization=True)
+    vocab = {chr(i + 32): i for i in range(0, 224)}
+    vocab["<unk>"] = 224
+    vocab["</s>"] = 225
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tok, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(path)
+    return path
+
+
+def test_real_corpus_real_checkpoint_one_command(tiny_llama_with_tok,
+                                                 capsys):
+    """VERDICT r4 next #9: the reference-comparable wikitext protocol is
+    ONE command against a real corpus file + real checkpoint dir —
+    `ppl.py --model <dir> --corpus <file>` runs end-to-end on the
+    checked-in real-text sample and emits the qtype ratio JSON."""
+    import json
+    import os
+
+    from benchmark.ppl import main as ppl_main
+
+    corpus = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmark", "data",
+        "sample_corpus.txt")
+    assert os.path.exists(corpus)
+    rc = ppl_main([
+        "--model", tiny_llama_with_tok, "--corpus", corpus,
+        "--qtypes", "bf16,sym_int4", "--seq-len", "128", "--stride", "64",
+        "--max-ratio", "2.0",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    res = json.loads(out)
+    assert rc == 0
+    assert res["ppl"]["bf16"]["ppl"] > 1.0
+    assert 0.5 < res["ppl"]["sym_int4"]["ratio_vs_bf16"] < 2.0

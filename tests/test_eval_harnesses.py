@@ -1,0 +1,219 @@
+"""WER + exam (ceval-style) harnesses (VERDICT r4 missing #5; reference
+dev/benchmark/whisper/ + dev/benchmark/ceval/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from benchmark.ceval import build_prompt, evaluate
+from benchmark.wer import corpus_wer, wer
+
+
+# ---------------------------------------------------------------------------
+# WER metric (jiwer-formula) unit checks against hand-computed values
+# ---------------------------------------------------------------------------
+
+
+def test_wer_known_values():
+    assert wer("the cat sat", "the cat sat") == 0.0
+    assert wer("the cat sat", "the cat sit") == pytest.approx(1 / 3)
+    assert wer("the cat sat", "the sat") == pytest.approx(1 / 3)  # deletion
+    assert wer("the cat sat", "the big cat sat") == pytest.approx(1 / 3)
+    assert wer("a b c d", "x y z w") == 1.0
+    assert wer("", "") == 0.0
+    assert wer("", "hello") == 1.0
+    # normalization: case + punctuation
+    assert wer("The CAT, sat!", "the cat sat") == 0.0
+
+
+def test_corpus_wer_aggregates_before_dividing():
+    res = corpus_wer([("a b c d", "a b c d"), ("x y", "x z")])
+    # 1 error over 6 reference words (NOT the mean of per-utt rates)
+    assert res["wer"] == pytest.approx(1 / 6, abs=1e-4)
+    assert res["utterances"] == 2
+    assert res["ref_words"] == 6
+    assert res["per_utt"] == [0.0, 0.5]
+
+
+# ---------------------------------------------------------------------------
+# exam harness: scoring logic against a deterministic fake LM + an
+# end-to-end run over a real (tiny) checkpoint
+# ---------------------------------------------------------------------------
+
+_QUESTIONS = [
+    {"subject": "physics", "question": "What force pulls objects down?",
+     "choices": {"A": "gravity", "B": "magnetism", "C": "light",
+                 "D": "sound"}, "answer": "A"},
+    {"subject": "physics", "question": "What is the unit of power?",
+     "choices": {"A": "newton", "B": "watt", "C": "joule", "D": "volt"},
+     "answer": "B"},
+    {"subject": "history", "question": "Which century had the year 1500?",
+     "choices": {"A": "14th", "B": "15th", "C": "16th", "D": "17th"},
+     "answer": "C"},
+]
+
+
+class _RiggedLM:
+    """Scores ' X' highest when the context contains the marker for X —
+    verifies evaluate() wires contexts and picks argmax correctly."""
+
+    def __init__(self, right_for: set[str]):
+        self.right_for = right_for
+
+    def loglikelihood(self, reqs):
+        out = []
+        for r in reqs:
+            ctx, cont = r.args
+            letter = cont.strip()
+            q = next(q for q in _QUESTIONS if q["question"] in ctx)
+            if q["subject"] in self.right_for:
+                out.append((0.0 if letter == q["answer"] else -10.0, False))
+            else:  # always pick the WRONG first option
+                wrong = next(c for c in ("A", "B", "C", "D")
+                             if c != q["answer"])
+                out.append((0.0 if letter == wrong else -10.0, False))
+        return out
+
+
+def test_exam_harness_scoring_logic():
+    res = evaluate(_RiggedLM({"physics", "history"}), _QUESTIONS)
+    assert res["accuracy"] == 1.0
+    assert res["subjects"] == {"physics": 1.0, "history": 1.0}
+
+    res = evaluate(_RiggedLM({"physics"}), _QUESTIONS)
+    assert res["subjects"]["physics"] == 1.0
+    assert res["subjects"]["history"] == 0.0
+    assert res["accuracy"] == pytest.approx(2 / 3, abs=1e-4)
+    assert res["n_questions"] == 3
+
+
+def test_exam_prompt_format_few_shot():
+    p = build_prompt(_QUESTIONS[0], [_QUESTIONS[1]])
+    assert "multiple choice questions" in p and "physics" in p
+    assert "Answer: B\n\n" in p          # the exemplar carries its answer
+    assert p.rstrip().endswith("Answer:")  # the target question does not
+
+
+def test_exam_harness_end_to_end(tmp_path):
+    """One command over a real checkpoint dir + question file: the ceval
+    protocol runs through the lm-eval adapter and emits the report."""
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import (LlamaConfig, LlamaForCausalLM,
+                              PreTrainedTokenizerFast)
+
+    path = str(tmp_path / "m")
+    torch.manual_seed(2)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False)).eval().save_pretrained(
+            path, safe_serialization=True)
+    vocab = {chr(i + 32): i for i in range(0, 224)}
+    vocab["<unk>"] = 224
+    vocab["</s>"] = 225
+    tk = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tk, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(path)
+
+    qfile = str(tmp_path / "q.json")
+    with open(qfile, "w") as f:
+        json.dump(_QUESTIONS, f)
+
+    from benchmark.ceval import main as ceval_main
+
+    rc = ceval_main(["--model", path, "--data", qfile,
+                     "--low-bit", "bf16", "--few-shot", "1"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# whisper WER selftest: features -> encode -> decode -> detokenize,
+# deterministic (WER(run, run) == 0) on a tiny random checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_whisper_wer_selftest(tmp_path):
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import (PreTrainedTokenizerFast, WhisperConfig,
+                              WhisperFeatureExtractor,
+                              WhisperForConditionalGeneration)
+
+    asr_path = str(tmp_path / "asr")
+    torch.manual_seed(3)
+    WhisperForConditionalGeneration(WhisperConfig(
+        vocab_size=200, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=16,
+        max_source_positions=75, max_target_positions=64,
+        decoder_start_token_id=2, eos_token_id=3, pad_token_id=0,
+        bos_token_id=1, suppress_tokens=None, begin_suppress_tokens=None,
+    )).eval().save_pretrained(asr_path, safe_serialization=True)
+    WhisperFeatureExtractor(feature_size=16).save_pretrained(asr_path)
+    vocab = {chr(i + 32): i for i in range(0, 224)}
+    vocab["<unk>"] = 224
+    vocab["</s>"] = 225
+    tk = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tk, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(asr_path)
+
+    from benchmark.wer import main as wer_main
+
+    rc = wer_main(["--model", asr_path, "--selftest", "--low-bit", "bf16"])
+    assert rc == 0
+
+
+def test_whisper_wer_audio_dir(tmp_path):
+    """The directory protocol: wav + txt pairs -> corpus WER report."""
+    import io
+    import wave
+
+    from tokenizers import Regex, Tokenizer, models, pre_tokenizers
+    from transformers import (PreTrainedTokenizerFast, WhisperConfig,
+                              WhisperFeatureExtractor,
+                              WhisperForConditionalGeneration)
+
+    asr_path = str(tmp_path / "asr2")
+    torch.manual_seed(4)
+    WhisperForConditionalGeneration(WhisperConfig(
+        vocab_size=200, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=16,
+        max_source_positions=75, max_target_positions=64,
+        decoder_start_token_id=2, eos_token_id=3, pad_token_id=0,
+        bos_token_id=1, suppress_tokens=None, begin_suppress_tokens=None,
+    )).eval().save_pretrained(asr_path, safe_serialization=True)
+    WhisperFeatureExtractor(feature_size=16).save_pretrained(asr_path)
+    vocab = {chr(i + 32): i for i in range(0, 224)}
+    vocab["<unk>"] = 224
+    vocab["</s>"] = 225
+    tk = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = pre_tokenizers.Split(Regex("."), "isolated")
+    PreTrainedTokenizerFast(tokenizer_object=tk, unk_token="<unk>",
+                            eos_token="</s>").save_pretrained(asr_path)
+
+    audio_dir = tmp_path / "wavs"
+    audio_dir.mkdir()
+    sr = 8000
+    t = np.arange(sr // 2) / sr
+    pcm = (np.sin(2 * np.pi * 440 * t) * 20000).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+    (audio_dir / "u1.wav").write_bytes(buf.getvalue())
+    (audio_dir / "u1.txt").write_text("a test sentence")
+
+    from benchmark.wer import run_dir
+
+    res = run_dir(asr_path, str(audio_dir), low_bit="bf16",
+                  max_new_tokens=8)
+    assert res["utterances"] == 1
+    assert res["ref_words"] == 3
+    assert 0.0 <= res["wer"]
