@@ -45,6 +45,8 @@ SLOW_MODULES = {
     "test_accuracy",         # ppl windows + lm-eval buckets
     "test_serving_tp",       # 8-device meshed engine compiles
     "test_pipeline",         # GPipe shard_map programs
+    "test_serving_scale",    # 64-row pool + 4.5K-token prefill
+    "test_eval_harnesses",   # whisper encode/decode + exam scoring runs
 }
 
 

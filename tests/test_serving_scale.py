@@ -1,0 +1,92 @@
+"""Engine scale: large row pools and >4K contexts through the paged pool
+(VERDICT r4 weak #7 — EngineConfig defaults are modest for the 70B story;
+this module drives the shapes the defaults don't).
+
+Slow tier (conftest SLOW_MODULES): a 64-row engine and a 4.5K-token prefill
+are real work on the CPU backend.
+"""
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+    stream_tokens,
+)
+from tests.test_decoder import rand_params, tiny_cfg
+from tests.test_serving import _assert_greedy_stream
+
+RNG = np.random.default_rng(5150)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=131, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=8192)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+def test_sixty_four_rows_eighty_requests(cfg_params):
+    """80 mixed-length requests through a 64-row pool: every stream
+    completes, row reuse stays isolated (spot-checked against the oracle),
+    and the page pool drains back to free/prefix-cached."""
+    cfg, params = cfg_params
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=64, max_seq_len=512, page_size=64,
+                     prefill_bucket=64),
+    ).start()
+    try:
+        prompts = [list(RNG.integers(0, cfg.vocab_size, int(n)))
+                   for n in RNG.integers(8, 200, 80)]
+        reqs = [eng.submit(Request(prompt_ids=p, max_new_tokens=8))
+                for p in prompts]
+        got = [list(stream_tokens(r, timeout=1800)) for r in reqs]
+    finally:
+        eng.stop()
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert all(len(g) == 8 for g in got)
+    assert eng.metrics["requests"] == 80
+    # spot-check correctness on a spread of streams (each check costs a
+    # full-sequence oracle forward)
+    for i in (0, 13, 41, 79):
+        _assert_greedy_stream(cfg, params, prompts[i], got[i])
+    # pool drained: every page free or held only by the prefix cache
+    cached = set(eng.alloc.prefix.values())
+    for pid in range(1, eng.alloc.n_pages):
+        refs = int(eng.alloc.ref[pid])
+        assert refs == 0 or (pid in cached and refs == 1), (pid, refs)
+
+
+def test_long_context_4k_plus(cfg_params):
+    """A >4K-token prompt runs through chunked prefill into the paged pool
+    (36 chunks at 128), decodes correctly, and a follow-up request sharing
+    the long prefix reuses its pages instead of re-prefilling."""
+    cfg, params = cfg_params
+    eng = ServingEngine(
+        cfg, params,
+        EngineConfig(max_rows=2, max_seq_len=8192, page_size=128,
+                     prefill_bucket=128, pool_pages=160),
+    ).start()
+    try:
+        base = list(RNG.integers(0, cfg.vocab_size, 4500))
+        r1 = eng.submit(Request(prompt_ids=base, max_new_tokens=6))
+        g1 = list(stream_tokens(r1, timeout=1800))
+        steps_after_first = eng.metrics["steps"]
+        # same long prefix + a short suffix: 35 full pages shareable
+        r2 = eng.submit(Request(prompt_ids=base + [5, 9, 3],
+                                max_new_tokens=6))
+        g2 = list(stream_tokens(r2, timeout=1800))
+    finally:
+        eng.stop()
+    assert len(g1) == 6 and len(g2) == 6
+    assert r1.finish_reason == "length" and r2.finish_reason == "length"
+    assert eng.metrics["prefix_hits"] >= 1
+    assert eng.metrics["prefix_pages_shared"] >= 35
+    _assert_greedy_stream(cfg, params, base, g1)
+    # the shared-prefix request must not have re-run the 36-chunk prefill:
+    # chunks run one per engine step, so its step count stays small
+    assert eng.metrics["steps"] - steps_after_first < 20
