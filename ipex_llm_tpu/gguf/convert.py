@@ -170,6 +170,73 @@ def _q5_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
     return QTensor(data, scales, zeros, "asym_int5", (n_in, out), 32)
 
 
+def _q2_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    """q2_k: 2-bit codes, 4-bit sub-scale/min pairs per 16 values scaled by
+    fp16 d/dmin.  Exact map: codes ride the nibble plane (values 0..3),
+    scales = d*sc and zeros = -dmin*m as f32 per 16-block."""
+    r = _blocks(raw, out, 84)
+    nb = n_in // 256
+    sb = r[:, :, 0:16]
+    qs = r[:, :, 16:80]
+    d = _f16(r[:, :, 80:82].copy().view(np.uint16)[:, :, 0])
+    dmin = _f16(r[:, :, 82:84].copy().view(np.uint16)[:, :, 0])
+    codes = np.empty((out, nb, 256), np.uint8)
+    sc16 = (sb & 0x0F).astype(np.float32)
+    m16 = (sb >> 4).astype(np.float32)
+    for n in range(2):
+        grp = qs[:, :, n * 32 : n * 32 + 32]
+        for si, shift in enumerate((0, 2, 4, 6)):
+            base = n * 128 + si * 32
+            codes[:, :, base : base + 32] = (grp >> shift) & 3
+    scales = (d[:, :, None] * sc16).reshape(out, nb * 16).T.copy()
+    zeros = (-dmin[:, :, None] * m16).reshape(out, nb * 16).T.copy()
+    data = _pack_from_row_codes(codes.reshape(out, n_in), 16)
+    return QTensor(data, scales, zeros, "asym_int4", (n_in, out), 16)
+
+
+def _q3_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    """q3_k: 3-bit codes (2-bit plane + hmask high bit), signed 6-bit
+    sub-scales per 16 values.  Exact map: c = q + 4*h in the nibble plane,
+    w = (c - 4) * d*sc = c*s + (-4s) — asym_int4 with zeros folded."""
+    r = _blocks(raw, out, 110)
+    nb = n_in // 256
+    hmask = r[:, :, 0:32]
+    qs = r[:, :, 32:96]
+    sb = r[:, :, 96:108].astype(np.int32)
+    d = _f16(r[:, :, 108:110].copy().view(np.uint16)[:, :, 0])
+    # 16 6-bit signed sub-scales (kquants._q3_scales layout)
+    sc16 = np.empty((out, nb, 16), np.float32)
+    for j in range(16):
+        low4 = (sb[..., j] & 0x0F) if j < 8 else (sb[..., j - 8] >> 4)
+        high2 = (sb[..., 8 + j % 4] >> (2 * (j // 4))) & 3
+        sc16[..., j] = (low4 | (high2 << 4)).astype(np.float32) - 32.0
+    codes = np.empty((out, nb, 256), np.uint8)
+    for n in range(2):
+        grp = qs[:, :, n * 32 : n * 32 + 32]
+        for si, shift in enumerate((0, 2, 4, 6)):
+            mbit = n * 4 + si
+            q = (grp >> shift) & 3
+            h = (hmask >> mbit) & 1
+            base = n * 128 + si * 32
+            codes[:, :, base : base + 32] = q + 4 * h
+    scales = (d[:, :, None] * sc16).reshape(out, nb * 16).T.copy()
+    zeros = (-4.0 * scales).copy()
+    data = _pack_from_row_codes(codes.reshape(out, n_in), 16)
+    return QTensor(data, scales, zeros, "asym_int4", (n_in, out), 16)
+
+
+def _q8_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
+    """q8_k: int8 codes with one f32 scale per 256 — exact sym_int8 with
+    block_size 256 (c = q + 128)."""
+    r = _blocks(raw, out, 292)
+    nb = n_in // 256
+    d = r[:, :, 0:4].copy().view(np.float32)[:, :, 0]            # [out, nb]
+    q = r[:, :, 4:260].view(np.int8).astype(np.int16) + 128
+    data = q.astype(np.uint8).reshape(out, n_in).T.copy()
+    scales = d.reshape(out, nb).T.astype(np.float32).copy()
+    return QTensor(data, scales, None, "sym_int8", (n_in, out), 256)
+
+
 def _q6_k_repack(raw: np.ndarray, out: int, n_in: int) -> QTensor:
     """q6_k: 6-bit codes, signed int8 scale per 16 values.  Exact map onto
     the kernel's byte-per-code path: c = q + 96 so (c - 128) = q - 32, with
@@ -199,8 +266,9 @@ _CONVERTERS = {
 }
 _KQUANTS = {"q2_k": 84, "q3_k": 110, "q4_k": 144, "q5_k": 176, "q6_k": 210,
             "q8_k": 292}
-_KQUANT_REPACK = {"q4_k": _q4_k_repack, "q5_k": _q5_k_repack,
-                  "q6_k": _q6_k_repack}
+_KQUANT_REPACK = {"q2_k": _q2_k_repack, "q3_k": _q3_k_repack,
+                  "q4_k": _q4_k_repack, "q5_k": _q5_k_repack,
+                  "q6_k": _q6_k_repack, "q8_k": _q8_k_repack}
 
 
 def to_dense(raw: np.ndarray, shape: tuple[int, ...], type_name: str) -> np.ndarray:

@@ -694,22 +694,23 @@ def _rand_kq_raw(rng, name, rows, n):
 
     raw = rng.integers(0, 256, (rows, n // 256, TYPE_SIZES[name]),
                        dtype=np.uint8)
-    # keep the fp16 scale fields finite
-    offs = {"q4_k": [1, 3], "q5_k": [1, 3], "q6_k": [209]}[name]
+    # keep the fp16 (or q8_k's fp32) scale fields finite
+    offs = {"q2_k": [81, 83], "q3_k": [109], "q4_k": [1, 3],
+            "q5_k": [1, 3], "q6_k": [209], "q8_k": [3]}[name]
     for o in offs:
         raw[:, :, o] &= 0x3B
     return raw
 
 
-@pytest.mark.parametrize("name", ["q4_k", "q5_k", "q6_k"])
+@pytest.mark.parametrize("name", ["q2_k", "q3_k", "q4_k", "q5_k", "q6_k",
+                                  "q8_k"])
 def test_kquant_repack_exact(name):
-    """q4_k/q5_k/q6_k repack bit-exactly onto asym_int4/asym_int5/byte-code
-    planes: dequantize(repacked) == the scalar superblock spec."""
-    from tests.test_kquants import scalar_q4_k, scalar_q5_k, scalar_q6_k
+    """EVERY k-quant repacks bit-exactly onto the fused-kernel planes:
+    dequantize(repacked) == the scalar superblock spec."""
+    from tests.test_kquants import SCALAR as SCALAR_DECODERS
     from ipex_llm_tpu.gguf.convert import to_qtensor
 
-    scalar = {"q4_k": scalar_q4_k, "q5_k": scalar_q5_k,
-              "q6_k": scalar_q6_k}[name]
+    scalar = SCALAR_DECODERS[name]
     rng = np.random.default_rng(11)
     rows, n = 3, 512
     raw = _rand_kq_raw(rng, name, rows, n)
