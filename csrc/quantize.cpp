@@ -125,4 +125,100 @@ int dequantize_sym(const uint8_t* data, const uint16_t* scales,
     return 0;
 }
 
+// asymmetric (q4_1/q5_1-style): d = (max-min)/(2^b-1), m = min,
+// q = clip(round((x-m)/d), 0, 2^b-1).  Bit-exact with
+// quantize/core.py::_quant_int_asym (codes from f32 d, scales/zeros
+// stored fp16).
+int quantize_asym(const float* w, int64_t n_in, int64_t n_out, int bs,
+                  int bits, uint8_t* data, uint16_t* scales,
+                  uint16_t* zeros) {
+    if (bits != 4 && bits != 8) return 1;
+    if (n_in % bs != 0) return 2;
+    const int64_t n_blocks = n_in / bs;
+    const int levels = (1 << bits) - 1;
+    const int half = bs / 2;
+
+#pragma omp parallel for schedule(static)
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        const float* blk = w + b * bs * n_out;
+        for (int64_t o = 0; o < n_out; ++o) {
+            float mn = blk[o], mx = blk[o];
+            for (int j = 1; j < bs; ++j) {
+                const float x = blk[(int64_t)j * n_out + o];
+                if (x < mn) mn = x;
+                if (x > mx) mx = x;
+            }
+            const float d = (mx - mn) / (float)levels;
+            scales[b * n_out + o] = f32_to_f16(d);
+            zeros[b * n_out + o] = f32_to_f16(mn);
+            const float inv = (d == 0.0f) ? 0.0f : 1.0f / d;
+            if (bits == 8) {
+                for (int j = 0; j < bs; ++j) {
+                    const float x = blk[(int64_t)j * n_out + o];
+                    float q = nearbyintf((x - mn) * inv);
+                    if (q < 0.f) q = 0.f;
+                    if (q > (float)levels) q = (float)levels;
+                    data[(b * bs + j) * n_out + o] = (uint8_t)q;
+                }
+            } else {
+                for (int j = 0; j < half; ++j) {
+                    const float xl = blk[(int64_t)j * n_out + o];
+                    const float xh = blk[(int64_t)(j + half) * n_out + o];
+                    float ql = nearbyintf((xl - mn) * inv);
+                    float qh = nearbyintf((xh - mn) * inv);
+                    if (ql < 0.f) ql = 0.f; if (ql > (float)levels) ql = (float)levels;
+                    if (qh < 0.f) qh = 0.f; if (qh > (float)levels) qh = (float)levels;
+                    data[(b * half + j) * n_out + o] =
+                        (uint8_t)ql | ((uint8_t)qh << 4);
+                }
+            }
+        }
+    }
+    return 0;
+}
+
+// 16-entry codebook (nf4/fp4): d = absmax (1 if 0), code = index of the
+// nearest table entry of x/d — FIRST minimum wins, matching jnp.argmin.
+// Bit-exact with quantize/core.py::_quant_codebook.
+int quantize_codebook(const float* w, int64_t n_in, int64_t n_out, int bs,
+                      const float* table, int n_table, uint8_t* data,
+                      uint16_t* scales) {
+    if (n_table > 16) return 1;  // must pack into nibbles
+    if (n_in % bs != 0) return 2;
+    if (bs > 512) return 3;      // per-column code scratch is stack-sized
+    const int64_t n_blocks = n_in / bs;
+    const int half = bs / 2;
+
+#pragma omp parallel for schedule(static)
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        const float* blk = w + b * bs * n_out;
+        for (int64_t o = 0; o < n_out; ++o) {
+            float amax = std::fabs(blk[o]);
+            for (int j = 1; j < bs; ++j) {
+                const float a = std::fabs(blk[(int64_t)j * n_out + o]);
+                if (a > amax) amax = a;
+            }
+            const float d = (amax == 0.0f) ? 1.0f : amax;
+            scales[b * n_out + o] = f32_to_f16(d);
+            const float inv = 1.0f / d;
+            uint8_t codes[512];
+            for (int j = 0; j < bs; ++j) {
+                const float xn = blk[(int64_t)j * n_out + o] * inv;
+                int best = 0;
+                float berr = std::fabs(xn - table[0]);
+                for (int t = 1; t < n_table; ++t) {
+                    const float e = std::fabs(xn - table[t]);
+                    if (e < berr) { berr = e; best = t; }
+                }
+                codes[j] = (uint8_t)best;
+            }
+            for (int j = 0; j < half; ++j) {
+                data[(b * half + j) * n_out + o] =
+                    codes[j] | (codes[j + half] << 4);
+            }
+        }
+    }
+    return 0;
+}
+
 }  // extern "C"

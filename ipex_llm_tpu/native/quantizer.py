@@ -61,6 +61,23 @@ def _load() -> ctypes.CDLL | None:
                         ctypes.c_int, ctypes.c_int,
                         ctypes.POINTER(ctypes.c_float),
                     ]
+                    lib.quantize_asym.restype = ctypes.c_int
+                    lib.quantize_asym.argtypes = [
+                        ctypes.POINTER(ctypes.c_float),
+                        ctypes.c_int64, ctypes.c_int64,
+                        ctypes.c_int, ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_uint8),
+                        ctypes.POINTER(ctypes.c_uint16),
+                        ctypes.POINTER(ctypes.c_uint16),
+                    ]
+                    lib.quantize_codebook.restype = ctypes.c_int
+                    lib.quantize_codebook.argtypes = [
+                        ctypes.POINTER(ctypes.c_float),
+                        ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+                        ctypes.POINTER(ctypes.c_uint8),
+                        ctypes.POINTER(ctypes.c_uint16),
+                    ]
                     _LIB = lib
                 except OSError:
                     _LIB = False
@@ -92,6 +109,64 @@ def quantize_sym_native(w: np.ndarray, bits: int, bs: int):
     rc = lib.quantize_sym(
         w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         n_in, n_out, bs, bits,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+    )
+    if rc != 0:
+        return None
+    return data, scales.view(np.float16)
+
+
+def quantize_asym_native(w: np.ndarray, bits: int, bs: int):
+    """Bit-exact native counterpart of core._quant_int_asym (q4_1/q5_1
+    style).  Returns (data uint8, scales f16, zeros f16) or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    w = np.ascontiguousarray(w, np.float32)
+    n_in, n_out = w.shape
+    pad = (-n_in) % bs
+    if pad:
+        w = np.concatenate([w, np.zeros((pad, n_out), np.float32)], axis=0)
+        n_in += pad
+    n_blocks = n_in // bs
+    data_rows = n_in // 2 if bits == 4 else n_in
+    data = np.empty((data_rows, n_out), np.uint8)
+    scales = np.empty((n_blocks, n_out), np.uint16)
+    zeros = np.empty((n_blocks, n_out), np.uint16)
+    rc = lib.quantize_asym(
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_in, n_out, bs, bits,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        zeros.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+    )
+    if rc != 0:
+        return None
+    return data, scales.view(np.float16), zeros.view(np.float16)
+
+
+def quantize_codebook_native(w: np.ndarray, table: np.ndarray, bs: int):
+    """Bit-exact native counterpart of core._quant_codebook for 16-entry
+    codebooks (nf4/fp4).  Returns (data uint8 nibbles, scales f16) or
+    None."""
+    lib = _load()
+    if lib is None or len(table) > 16 or bs > 512:
+        return None
+    w = np.ascontiguousarray(w, np.float32)
+    n_in, n_out = w.shape
+    pad = (-n_in) % bs
+    if pad:
+        w = np.concatenate([w, np.zeros((pad, n_out), np.float32)], axis=0)
+        n_in += pad
+    n_blocks = n_in // bs
+    t = np.ascontiguousarray(table, np.float32)
+    data = np.empty((n_in // 2, n_out), np.uint8)
+    scales = np.empty((n_blocks, n_out), np.uint16)
+    rc = lib.quantize_codebook(
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_in, n_out, bs,
+        t.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), len(t),
         data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         scales.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
     )

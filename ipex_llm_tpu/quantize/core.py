@@ -613,26 +613,39 @@ def quantize(w: Any, qtype: str, block_size: int | None = None, *,
     info = qtypes.resolve(qtype)
     if (
         isinstance(w, _np.ndarray)
-        and info.kind == "int_sym"
+        and info.kind in ("int_sym", "int_asym", "codebook")
         and int(info.bits) in (4, 8)
         and not optimize
         and imatrix is None
     ):
         # C++ quantizer (the ggml CPU quantizer equivalent, native/): same
-        # math, fraction of the load-time cost; falls through when the
-        # library is unavailable
+        # math for sym/asym int and the 16-entry codebooks, a fraction of
+        # the load-time cost; falls through when the library is unavailable
         from ipex_llm_tpu.native import quantizer as _nq
 
         if _nq.available():
             shape = tuple(w.shape)
             bs = block_size or info.block_size
-            out = _nq.quantize_sym_native(
-                _np.asarray(w, _np.float32), int(info.bits), bs
-            )
-            if out is not None:
-                data, scales = out
-                return QTensor(jnp.asarray(data), jnp.asarray(scales), None,
-                               info.name, shape, bs)
+            wf = _np.asarray(w, _np.float32)
+            if info.kind == "int_sym":
+                out = _nq.quantize_sym_native(wf, int(info.bits), bs)
+                if out is not None:
+                    data, scales = out
+                    return QTensor(jnp.asarray(data), jnp.asarray(scales),
+                                   None, info.name, shape, bs)
+            elif info.kind == "int_asym":
+                out = _nq.quantize_asym_native(wf, int(info.bits), bs)
+                if out is not None:
+                    data, scales, zeros = out
+                    return QTensor(jnp.asarray(data), jnp.asarray(scales),
+                                   jnp.asarray(zeros), info.name, shape, bs)
+            elif int(info.bits) == 4:  # codebook: nf4 / fp4
+                out = _nq.quantize_codebook_native(
+                    wf, _codebook_table(info.name), bs)
+                if out is not None:
+                    data, scales = out
+                    return QTensor(jnp.asarray(data), jnp.asarray(scales),
+                                   None, info.name, shape, bs)
 
     w = _as_jnp_f32(w)
     if w.ndim != 2:
