@@ -171,7 +171,7 @@ def pp_decode_step(
     cfg: ModelConfig,
     params: dict,
     cache,                       # PagedKVCache, pool layer axis pp-sharded
-    toks: jnp.ndarray,           # [R] current token per row
+    toks: jnp.ndarray,           # [R] current token — or [R, T] wide step
     row_lens: jnp.ndarray,       # [R] slots already in cache
     mesh,
     n_micro: int,
@@ -184,9 +184,14 @@ def pp_decode_step(
     different request group each tick — the stage-sequential GSPMD decode
     keeps (pp-1)/pp chips idle instead.
 
+    ``toks`` may be [R] (plain decode) or [R, T] (the speculative verify
+    step's [cur_tok; drafts] window): each group's T tokens ride one
+    microbatch, so speculative serving pipelines exactly like plain decode.
+
     Writes go through each group's block tables; drain/fill ticks run with
     all-(-1) tables so their garbage lands on the scratch page (kv.py
-    update_layer contract).  Returns (logits [R, V], updated cache).
+    update_layer contract).  Returns (logits [R, V] for 1-D input,
+    [R, T, V] for 2-D, and the updated cache).
     """
     from dataclasses import replace as _dc_replace
 
@@ -201,13 +206,15 @@ def pp_decode_step(
     if "layers_dense" in params:
         raise NotImplementedError("dense-prefix MoE models don't pipeline yet")
     pp = mesh.shape["pp"]
-    r = toks.shape[0]
+    wide = toks.ndim == 2
+    tokens = toks if wide else toks[:, None]     # [R, T]
+    r, t_w = tokens.shape
     if r % n_micro:
         raise ValueError(f"rows {r} not divisible by n_micro {n_micro}")
     rm = r // n_micro
 
-    pos = row_lens[:, None]                      # [R, 1]
-    x, cos, sin = embed_prelude(cfg, params, toks[:, None], pos)
+    pos = row_lens[:, None] + jnp.arange(t_w)[None, :]   # [R, T]
+    x, cos, sin = embed_prelude(cfg, params, tokens, pos)
     cos_l, sin_l = local_rope_tables(cfg, params, pos)
 
     def grp(a):
@@ -215,7 +222,7 @@ def pp_decode_step(
 
     # everything the stage body reads must enter through shard_map args —
     # closing over auto-context arrays inside the manual region is invalid
-    aux = {"x": x.reshape(n_micro, rm, 1, x.shape[-1]),
+    aux = {"x": x.reshape(n_micro, rm, t_w, x.shape[-1]),
            "tables": cache.tables.reshape(n_micro, rm, -1),
            "lens": row_lens.reshape(n_micro, rm)}
     for name, a in (("cos", grp(cos)), ("sin", grp(sin)),
@@ -243,14 +250,14 @@ def pp_decode_step(
             # fill/drain ticks write to the scratch page, never live pages
             tabs = jnp.where(valid, pick("tables", mic), -1)
             lens = pick("lens", mic)
-            q_slots = lens[:, None]
+            q_slots = lens[:, None] + jnp.arange(t_w)[None, :]
             group_cache = _dc_replace(cache, k=k_loc, v=v_loc, tables=tabs)
             bias = (alibi_bias_for(cfg, q_slots, cache.max_len)
                     if cfg.alibi else None)
             y, k_loc, v_loc, _ = run_layers(
                 cfg, layer_tree, k_loc, v_loc, flags, xin,
                 pick("cos", mic), pick("sin", mic), lens, q_slots,
-                lens + 1, None, group_cache, alibi_bias=bias,
+                lens + t_w, None, group_cache, alibi_bias=bias,
                 cos_local=pick("cos_l", mic), sin_local=pick("sin_l", mic),
             )
             contrib = jnp.where((stage == pp - 1) & valid, y,
@@ -291,5 +298,7 @@ def pp_decode_step(
         axis_names={"pp"},
     )(params["layers"], sliding_flags, cache.k, cache.v, aux)
 
-    logits = logits_tail(cfg, params, out.reshape(r, 1, -1))[:, 0]
+    logits = logits_tail(cfg, params, out.reshape(r, t_w, -1))
+    if not wide:
+        logits = logits[:, 0]
     return logits, _dc_replace(cache, k=k_new, v=v_new)

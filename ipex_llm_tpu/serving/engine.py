@@ -230,6 +230,33 @@ def _pp_decode_sample(cfg: ModelConfig, params, cache, toks, row_lens,
     return nxt, lp, cache, key
 
 
+@partial(jax.jit, static_argnames=("cfg", "k", "mesh", "n_micro"),
+         donate_argnums=(2,))
+def _pp_verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
+                    active, temps, top_ps, key, seeds, steps, top_ks,
+                    k: int, mesh=None, n_micro=2):
+    """Speculative verify step through the GPipe pipeline: the [R, k+1]
+    window rides the request-group microbatches (pp_decode_step's wide
+    form), then every position samples exactly like _verify_step."""
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+    from ipex_llm_tpu.parallel.pipeline import pp_decode_step
+
+    tokens = jnp.concatenate([toks[:, None], drafts], axis=1)   # [R, k+1]
+    logits, cache = pp_decode_step(cfg, params, cache, tokens, row_lens,
+                                   mesh, n_micro)
+    key, sub = jax.random.split(key)
+    subkeys = jax.random.split(sub, k + 1)
+    steps_mat = steps[:, None] + jnp.arange(k + 1)[None, :]
+    t_all, lp_all = jax.vmap(
+        lambda lg_j, key_j, st_j: sample_rows_with_logprobs(
+            lg_j, temps, top_ps, key_j, seeds=seeds, steps=st_j,
+            top_ks=top_ks),
+        in_axes=(1, 0, 1), out_axes=1,
+    )(logits, subkeys, steps_mat)
+    t_all = jnp.where(active[:, None], t_all, 0)
+    return t_all, lp_all, cache, key
+
+
 @partial(jax.jit, static_argnames=("cfg", "k", "mesh"), donate_argnums=(2,))
 def _verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
                  active, temps, top_ps, key, seeds, steps, top_ks, k: int,
@@ -363,16 +390,15 @@ class ServingEngine:
         # pipelined decode (PPModelWorker peer): GPipe request groups over
         # the pp axis; a tp axis on the same mesh composes via partial-auto
         # shard_map (GSPMD tp-shards each stage's matmuls inside the manual
-        # region).  What it can't serve (MoE dual stack, non-dividing
-        # shapes, speculative — the wide verify step isn't pipelined) falls
-        # back to GSPMD stage-sequential decode, which is correct but
-        # leaves (pp-1)/pp chips idle.
+        # region), and speculative verify steps ride the pipeline's wide
+        # (T=k+1) form.  What it can't serve (MoE dual stack, non-dividing
+        # shapes) falls back to GSPMD stage-sequential decode, which is
+        # correct but leaves (pp-1)/pp chips idle.
         pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
         self._pp_mode = (
             pp > 1
             and cfg.num_layers % pp == 0
             and r % pp == 0
-            and self.ec.spec_k == 0
             and "layers_dense" not in params
         )
         self.alloc = PageAllocator(self.ec.n_pages)
@@ -674,13 +700,17 @@ class ServingEngine:
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)
-        t_all, lp_all, self.cache, self.key = _verify_step(
+        verify_fn, extra = _verify_step, {}
+        if self._pp_mode:
+            verify_fn = _pp_verify_step
+            extra = {"n_micro": self.mesh.shape["pp"]}
+        t_all, lp_all, self.cache, self.key = verify_fn(
             self.cfg, self.params, cache,
             jnp.asarray(self.toks), jnp.asarray(drafts),
             jnp.asarray(self.row_lens), jnp.asarray(active),
             jnp.asarray(self.temps), jnp.asarray(self.top_ps), self.key,
             jnp.asarray(self.seeds), jnp.asarray(steps),
-            jnp.asarray(self.top_ks), k=k, mesh=self.mesh,
+            jnp.asarray(self.top_ks), k=k, mesh=self.mesh, **extra,
         )
         t_all, lp_all = np.asarray(t_all), np.asarray(lp_all)
         self.metrics["steps"] += 1
