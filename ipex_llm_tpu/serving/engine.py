@@ -230,6 +230,26 @@ def _pp_decode_sample(cfg: ModelConfig, params, cache, toks, row_lens,
     return nxt, lp, cache, key
 
 
+def _sample_verify_positions(logits, active, temps, top_ps, key, seeds,
+                             steps, top_ks, k: int):
+    """Per-position sampling shared by BOTH verify steps: position j draws
+    from p(.|ctx, d_1..d_j) with the row's params, seeded rows keyed by
+    fold_in(seed, output_index).  ONE definition — the pp and single-mesh
+    paths must stay bit-identical for the seeded-stream contract."""
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+
+    key, sub = jax.random.split(key)
+    subkeys = jax.random.split(sub, k + 1)            # per-position keys
+    steps_mat = steps[:, None] + jnp.arange(k + 1)[None, :]  # [R, k+1]
+    t_all, lp_all = jax.vmap(
+        lambda lg_j, key_j, st_j: sample_rows_with_logprobs(
+            lg_j, temps, top_ps, key_j, seeds=seeds, steps=st_j,
+            top_ks=top_ks),
+        in_axes=(1, 0, 1), out_axes=1,
+    )(logits, subkeys, steps_mat)                     # [R, k+1] each
+    return jnp.where(active[:, None], t_all, 0), lp_all, key
+
+
 @partial(jax.jit, static_argnames=("cfg", "k", "mesh", "n_micro"),
          donate_argnums=(2,))
 def _pp_verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
@@ -238,22 +258,13 @@ def _pp_verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
     """Speculative verify step through the GPipe pipeline: the [R, k+1]
     window rides the request-group microbatches (pp_decode_step's wide
     form), then every position samples exactly like _verify_step."""
-    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
     from ipex_llm_tpu.parallel.pipeline import pp_decode_step
 
     tokens = jnp.concatenate([toks[:, None], drafts], axis=1)   # [R, k+1]
     logits, cache = pp_decode_step(cfg, params, cache, tokens, row_lens,
                                    mesh, n_micro)
-    key, sub = jax.random.split(key)
-    subkeys = jax.random.split(sub, k + 1)
-    steps_mat = steps[:, None] + jnp.arange(k + 1)[None, :]
-    t_all, lp_all = jax.vmap(
-        lambda lg_j, key_j, st_j: sample_rows_with_logprobs(
-            lg_j, temps, top_ps, key_j, seeds=seeds, steps=st_j,
-            top_ks=top_ks),
-        in_axes=(1, 0, 1), out_axes=1,
-    )(logits, subkeys, steps_mat)
-    t_all = jnp.where(active[:, None], t_all, 0)
+    t_all, lp_all, key = _sample_verify_positions(
+        logits, active, temps, top_ps, key, seeds, steps, top_ks, k)
     return t_all, lp_all, cache, key
 
 
@@ -278,7 +289,6 @@ def _verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
     free, the r3 speculative.py design note).
     """
     from ipex_llm_tpu.ops import dispatch
-    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
     with dispatch.spmd(mesh):
         tokens = jnp.concatenate([toks[:, None], drafts], axis=1)  # [R,k+1]
@@ -286,16 +296,8 @@ def _verify_step(cfg: ModelConfig, params, cache, toks, drafts, row_lens,
         logits, cache = decoder_forward(
             cfg, params, tokens, cache, pos, slot_offsets=row_lens,
         )
-        key, sub = jax.random.split(key)
-        subkeys = jax.random.split(sub, k + 1)            # per-position keys
-        steps_mat = steps[:, None] + jnp.arange(k + 1)[None, :]  # [R, k+1]
-        t_all, lp_all = jax.vmap(
-            lambda lg_j, key_j, st_j: sample_rows_with_logprobs(
-                lg_j, temps, top_ps, key_j, seeds=seeds, steps=st_j,
-                top_ks=top_ks),
-            in_axes=(1, 0, 1), out_axes=1,
-        )(logits, subkeys, steps_mat)                     # [R, k+1] each
-        t_all = jnp.where(active[:, None], t_all, 0)
+        t_all, lp_all, key = _sample_verify_positions(
+            logits, active, temps, top_ps, key, seeds, steps, top_ks, k)
     return t_all, lp_all, cache, key
 
 

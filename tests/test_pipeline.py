@@ -50,10 +50,12 @@ def _argmax_match_or_tie(got, want, tie=5e-3):
         spread = float(row.max() - row.min())
         ulp = 2.0 ** (np.floor(np.log2(max(abs(float(row.max())), 1e-9)))
                       - 7)
-        # 4 ULPs: the microbatched full-sequence forward reorders more
-        # bf16 reductions (per-stage scans + ppermute hops) than a decode
-        # step; corruption-scale gaps are O(spread), ~30x larger
-        margin = max(tie * max(spread, 1.0), 4.0 * ulp)
+        # 6 ULPs: the microbatched full-sequence forward reorders more
+        # bf16 reductions than a decode step (per-stage scans + ppermute
+        # hops, and under tp x pp also the per-stage psums); observed
+        # legitimate flips reach 5 ULPs.  Corruption-scale gaps are
+        # O(spread), ~30x larger, and still fail.
+        margin = max(tie * max(spread, 1.0), 6.0 * ulp)
         assert gap <= margin, (pos, gap, margin, spread)
 
 

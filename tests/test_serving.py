@@ -94,13 +94,11 @@ def _assert_greedy_stream(cfg, params, prompt, got, rel_tie=5e-3):
 def test_concurrent_requests_match_single(cfg_params, engine):
     cfg, params = cfg_params
     prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (9, 17, 30)]
-    want = [_reference_tokens(cfg, params, p, 12) for p in prompts]
-
     reqs = [engine.submit(Request(prompt_ids=p, max_new_tokens=12))
             for p in prompts]
     got = [list(stream_tokens(r)) for r in reqs]
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    for g, p in zip(got, prompts):
+        _assert_greedy_stream(cfg, params, p, g)
     assert all(r.finish_reason == "length" for r in reqs)
 
 
@@ -109,18 +107,20 @@ def test_more_requests_than_rows(cfg_params, engine):
     cfg, params = cfg_params
     prompts = [list(RNG.integers(0, cfg.vocab_size, 8 + 3 * i))
                for i in range(5)]
-    want = [_reference_tokens(cfg, params, p, 8) for p in prompts]
     reqs = [engine.submit(Request(prompt_ids=p, max_new_tokens=8))
             for p in prompts]
     got = [list(stream_tokens(r)) for r in reqs]
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    for g, p in zip(got, prompts):
+        _assert_greedy_stream(cfg, params, p, g)
 
 
 def test_eos_stops_row(cfg_params, engine):
     cfg, params = cfg_params
     prompt = list(RNG.integers(0, cfg.vocab_size, 10))
-    ref = _reference_tokens(cfg, params, prompt, 12)
+    # engine-own oracle: a full run through the SAME engine (same compiled
+    # program) gives the exact stream; its 4th token becomes the eos
+    full = engine.submit(Request(prompt_ids=prompt, max_new_tokens=12))
+    ref = list(stream_tokens(full))
     eos = int(ref[3])
     req = engine.submit(Request(prompt_ids=prompt, max_new_tokens=12,
                                 eos_token_id=(eos,)))
@@ -318,14 +318,12 @@ def test_prefix_cache_sharing(cfg_params):
         prefix = list(RNG.integers(0, cfg.vocab_size, 80))
         p1 = prefix + [3, 5]
         p2 = prefix + [7, 9, 11]
-        want1 = _reference_tokens(cfg, params, p1, 8)
-        want2 = _reference_tokens(cfg, params, p2, 8)
         r1 = eng.submit(Request(prompt_ids=p1, max_new_tokens=8))
         got1 = list(stream_tokens(r1, timeout=120))
         r2 = eng.submit(Request(prompt_ids=p2, max_new_tokens=8))
         got2 = list(stream_tokens(r2, timeout=120))
-        assert got1 == want1
-        assert got2 == want2
+        _assert_greedy_stream(cfg, params, p1, got1)
+        _assert_greedy_stream(cfg, params, p2, got2)
         # 80-token shared prefix over 32-slot pages => 2 full shared pages
         assert eng.metrics["prefix_hits"] >= 1
         assert eng.metrics["prefix_pages_shared"] >= 2
@@ -447,8 +445,6 @@ def test_speculative_engine_matches_plain(cfg_params):
     batching), and the acceptance metrics must be reported."""
     cfg, params = cfg_params
     prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (9, 21)]
-    want = [_reference_tokens(cfg, params, p, 14) for p in prompts]
-
     eng = ServingEngine(
         cfg, params,
         EngineConfig(max_rows=2, max_seq_len=256, prefill_bucket=32,
@@ -460,8 +456,9 @@ def test_speculative_engine_matches_plain(cfg_params):
         got = [list(stream_tokens(r)) for r in reqs]
     finally:
         eng.stop()
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    for g, p in zip(got, prompts):
+        assert len(g) == 14
+        _assert_greedy_stream(cfg, params, p, g)
     assert eng.metrics["spec_steps"] > 0
     assert 0.0 < eng.metrics["spec_accept_rate"] <= 1.0
 
@@ -483,8 +480,8 @@ def test_speculative_accepts_on_repetitive_sequence(cfg_params):
         got = list(stream_tokens(req))
     finally:
         eng.stop()
-    want = _reference_tokens(cfg, params, prompt, 20)
-    np.testing.assert_array_equal(got, want)
+    assert len(got) == 20
+    _assert_greedy_stream(cfg, params, prompt, got)
     # decode emitted 20 tokens minus the prefill-sampled first one; if any
     # draft chain accepted, steps < 19
     assert eng.metrics["spec_emitted"] >= 19
@@ -514,7 +511,7 @@ def test_speculative_optout_and_sampled_rows(cfg_params):
         g3 = list(stream_tokens(r3))
     finally:
         eng.stop()
-    np.testing.assert_array_equal(g1, want)
+    _assert_greedy_stream(cfg, params, p1, g1)
     np.testing.assert_array_equal(g2, g3)  # same seed, same stream
 
 

@@ -50,8 +50,6 @@ def _reference_tokens(cfg, params, prompt, n):
 def test_tp_engine_matches_single_device(cfg_params, spec):
     cfg, params = cfg_params
     prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (7, 19, 41)]
-    want = [_reference_tokens(cfg, params, p, 10) for p in prompts]
-
     mesh = make_mesh(spec)
     eng = ServingEngine(
         cfg, params,
@@ -64,8 +62,9 @@ def test_tp_engine_matches_single_device(cfg_params, spec):
         got = [list(stream_tokens(r)) for r in reqs]
     finally:
         eng.stop()
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    for g, p in zip(got, prompts):
+        assert len(g) == 10
+        _assert_greedy_stream(cfg, params, p, g)
     assert all(r.finish_reason == "length" for r in reqs)
 
 
@@ -105,7 +104,8 @@ def test_tp_engine_paged_kernel_path(cfg_params, monkeypatch):
         monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS")
         dispatch.clear_cache()
     assert calls["n"] > 0, "sharded paged kernel was never dispatched"
-    np.testing.assert_array_equal(got, want)
+    assert len(got) == 6
+    _assert_greedy_stream(cfg, params, prompt, got)
 
 
 def test_tp_gqa_fewer_kv_heads_than_chips(monkeypatch):
@@ -154,7 +154,12 @@ def test_tp_gqa_fewer_kv_heads_than_chips(monkeypatch):
         monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS")
         dispatch.clear_cache()
     assert calls["n"] > 0, "sharded paged kernel skipped for GQA hkv<tp"
-    np.testing.assert_array_equal(got, want)
+    # single-device vs tp-sharded kernels are different programs too:
+    # validate both against the teacher-forcing oracle instead of
+    # requiring bit-equality between them
+    assert len(got) == 6 and len(want) == 6
+    _assert_greedy_stream(cfg, params, prompt, got)
+    _assert_greedy_stream(cfg, params, prompt, want)
 
 
 def test_tp_engine_prefix_cache_and_reuse(cfg_params):
@@ -170,7 +175,6 @@ def test_tp_engine_prefix_cache_and_reuse(cfg_params):
     try:
         shared = list(RNG.integers(0, cfg.vocab_size, 40))
         tails = [list(RNG.integers(0, cfg.vocab_size, 5)) for _ in range(3)]
-        want = [_reference_tokens(cfg, params, shared + t, 6) for t in tails]
         got = []
         for t in tails:  # sequential: later ones hit the prefix cache
             req = eng.submit(Request(prompt_ids=shared + t, max_new_tokens=6))
@@ -178,8 +182,9 @@ def test_tp_engine_prefix_cache_and_reuse(cfg_params):
         assert eng.metrics["prefix_hits"] >= 1
     finally:
         eng.stop()
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    for g, t in zip(got, tails):
+        assert len(g) == 6
+        _assert_greedy_stream(cfg, params, shared + t, g)
 
 
 def test_http_server_over_tp_engine(cfg_params):
@@ -242,8 +247,6 @@ def test_pp_engine_matches_single_device(cfg_params):
     cfg, params = cfg_params
     prompts = [list(RNG.integers(0, cfg.vocab_size, n))
                for n in (7, 15, 23, 31)]
-    want = [_reference_tokens(cfg, params, p, 8) for p in prompts]
-
     mesh = make_mesh(MeshSpec(pp=2))
     eng = ServingEngine(
         cfg, params,
@@ -257,8 +260,9 @@ def test_pp_engine_matches_single_device(cfg_params):
         got = [list(stream_tokens(r, timeout=300)) for r in reqs]
     finally:
         eng.stop()
-    for g, w in zip(got, want):
-        np.testing.assert_array_equal(g, w)
+    for g, p in zip(got, prompts):
+        assert len(g) == 8
+        _assert_greedy_stream(cfg, params, p, g)
 
 
 def test_pp_engine_row_churn(cfg_params):
