@@ -252,10 +252,13 @@ def main():
             from benchmark.serving_bench import collect as serve_collect
 
             # reuse the already-built model (a second 7B build would double
-            # HBM residency on the chip)
+            # HBM residency on the chip).  The horizon sweep (H=1 baseline
+            # + fused H=4/8 at concurrency 4) reports steps_per_sync next
+            # to agg_tok_s — the host-dispatch amortization story.
             serving = serve_collect(
                 cfg=r["cfg"], params=r["params"],
-                levels=(1, 4, 16) if on_tpu else (1, 4))
+                levels=(1, 4, 16) if on_tpu else (1, 4),
+                horizons=(1, 4, 8))
         except Exception as e:  # noqa: BLE001
             print(f"bench: serving bench failed ({type(e).__name__}: {e})",
                   file=sys.stderr)

@@ -187,6 +187,15 @@ class PagedKVCache:
     def decode_layer(self, kl: jnp.ndarray, compute_dtype=jnp.bfloat16) -> jnp.ndarray:
         return kl.astype(compute_dtype)
 
+    def with_tables(self, tables: jnp.ndarray) -> "PagedKVCache":
+        """This pool with a different row->page ``tables`` view (pure-data
+        replace).  The serving engine's device-resident-state contract
+        hangs off this: tables are swapped in ONLY at epoch boundaries
+        (admission / prefill / finish / page allocation); between epochs
+        the fused decode horizon carries the same device array forward, so
+        steady-state decode re-uploads nothing."""
+        return replace(self, tables=tables)
+
     def update_layer(self, kl: jnp.ndarray, vl: jnp.ndarray,
                      new_k: jnp.ndarray, new_v: jnp.ndarray, pos: jnp.ndarray):
         """Scatter new_k/new_v [B, T, H, D] into pool layer [P, H, page, D]

@@ -103,7 +103,13 @@ def _use_pallas_env() -> bool:
     if os.environ.get("IPEX_LLM_TPU_DISABLE_PALLAS", "0") == "1":
         return False
     if os.environ.get("IPEX_LLM_TPU_FORCE_PALLAS", "0") == "1":
-        return True  # tests: interpret-mode kernels on CPU
+        return True  # kernel testing: interpret-mode Pallas off-TPU
+    # Auto policy: only real TPU backends run the Pallas kernels.  On the
+    # CPU backend the kernels would execute in the Pallas INTERPRETER,
+    # which is strictly slower than the XLA reference path (BENCH_r05
+    # microbench: decode_attn 540us interpret vs 268us XLA) — so CPU
+    # auto-prefers the XLA path and interpret-mode stays opt-in via
+    # IPEX_LLM_TPU_FORCE_PALLAS=1.
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:

@@ -86,7 +86,8 @@ def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
                               top_ps: jnp.ndarray, key: jax.Array,
                               seeds: jnp.ndarray | None = None,
                               steps: jnp.ndarray | None = None,
-                              top_ks: jnp.ndarray | None = None):
+                              top_ks: jnp.ndarray | None = None,
+                              active: jnp.ndarray | None = None):
     """sample_rows plus the chosen token's logprob under the MODEL
     distribution (raw log-softmax, the OpenAI ``logprobs`` convention —
     not the temperature/top-p-modified sampling distribution).
@@ -94,7 +95,12 @@ def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
     ``seeds`` [R] int32 (-1 = unseeded) with ``steps`` [R] gives rows a
     DETERMINISTIC stream — fold_in(PRNGKey(seed), step) — independent of
     which other requests share the batch; unseeded rows derive per-row
-    keys from the engine's stepping key."""
+    keys from the engine's stepping key.
+
+    ``active`` [R] bool masks dead rows to (token 0, logprob 0) — ONE
+    definition of the serving engines' row masking, shared by the plain,
+    pipelined, and fused-horizon decode steps so their emitted padding
+    stays identical."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-4)[:, None]
@@ -118,6 +124,9 @@ def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
     lp = jnp.take_along_axis(
         jax.nn.log_softmax(logits, axis=-1), chosen[:, None], axis=-1
     )[:, 0]
+    if active is not None:
+        chosen = jnp.where(active, chosen, 0)
+        lp = jnp.where(active, lp, 0.0)
     return chosen, lp
 
 

@@ -188,6 +188,14 @@ def pp_decode_step(
     step's [cur_tok; drafts] window): each group's T tokens ride one
     microbatch, so speculative serving pipelines exactly like plain decode.
 
+    Fused-horizon contract: the engine's `_horizon_step` entry routes pp
+    meshes here with H pinned to 1 — scanning a decode horizon over this
+    schedule would nest a full GPipe fill/drain (pp-1 bubble ticks) inside
+    every horizon step, and the stage-sharded pool would have to ride the
+    scan carry.  Pipelining the horizon (fill the schedule with H
+    successive tokens of the same groups) is the designed follow-up; until
+    then pp decode re-uploads per step like the historical path.
+
     Writes go through each group's block tables; drain/fill ticks run with
     all-(-1) tables so their garbage lands on the scratch page (kv.py
     update_layer contract).  Returns (logits [R, V] for 1-D input,

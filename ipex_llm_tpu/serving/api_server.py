@@ -311,6 +311,16 @@ class OpenAIServer:
         last = self.engine.metrics.get("last_error")
         if last:
             body = {"status": "degraded", "last_error": str(last)}
+        # host-sync economics of the fused decode horizon: tokens emitted
+        # per blocking device->host sync, total seconds blocked, and the
+        # horizon the last fused step actually ran (page pressure can
+        # shorten it below EngineConfig.decode_horizon)
+        m = self.engine.metrics
+        body["decode"] = {
+            "tokens_per_sync": m.get("tokens_per_sync", 0.0),
+            "host_sync_s": m.get("host_sync_s", 0.0),
+            "decode_horizon_effective": m.get("decode_horizon_effective", 0),
+        }
         return web.json_response(body)
 
     async def metrics(self, request):
@@ -509,11 +519,17 @@ def main(argv=None):
                     help="prompt-lookup speculative serving: verify K "
                          "candidates per step (reference ipex_llm_worker "
                          "`speculative` flag); acceptance rate in /metrics")
+    ap.add_argument("--decode-horizon", type=int, default=1, metavar="H",
+                    help="fused multi-step decode: run H decode steps per "
+                         "device program (one host sync per H tokens; "
+                         "streaming granularity becomes up to H tokens; "
+                         "mutually exclusive with --speculative)")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
         EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len,
-                     spec_k=args.speculative),
+                     spec_k=args.speculative,
+                     decode_horizon=args.decode_horizon),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
     )

@@ -65,6 +65,18 @@ class EngineConfig:
     # only T=1 steps); tp meshes shard it like any decode.
     spec_k: int = 0
     spec_ngram: int = 3         # n-gram length for host-side lookup
+    # fused decode horizon: >1 runs up to H decode+sample steps in ONE
+    # jitted on-device loop (``_decode_multi_step``: ``lax.while_loop``
+    # that exits early once every row is dead) with device-resident engine
+    # state, so the host pays ONE blocking sync per H tokens instead
+    # of per token — the fix for the dispatch-bound regime BENCH_r05
+    # measured (per-stream tok/s collapsing under concurrency from host
+    # orchestration, not FLOPs; the vLLM multi-step / MaxText on-device
+    # generate-loop peers).  Per-row EOS/length early-stop is masked on
+    # device, so fused output is bit-identical to H=1 under the seeded-
+    # stream contract.  Streaming granularity becomes up to H tokens.
+    # Mutually exclusive with spec_k for now (both widen the step).
+    decode_horizon: int = 1
 
     @property
     def n_pages(self) -> int:
@@ -181,21 +193,40 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
     return keys
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
-def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
-                 temps, top_ps, key, seeds, steps, top_ks, mesh=None):
-    """One batched decode step over the whole row pool.
+@partial(jax.jit, static_argnames=("cfg", "horizon", "mesh"),
+         donate_argnums=(2,))
+def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
+                       active, temps, top_ps, key, seeds, steps, top_ks,
+                       eos, remain, horizon: int = 1, mesh=None):
+    """Fused decode horizon: up to ``horizon`` decode+sample steps over the
+    whole row pool in ONE device program (a ``lax.while_loop`` over the
+    donated cache — not ``lax.scan``, because the loop must exit early the
+    moment every row is dead) — the host syncs once per H tokens instead
+    of once per token.
 
-    toks [R] current token per row; row_lens [R] tokens already in cache.
+    toks [R] current token per row; row_lens [R] slots already in cache;
+    eos [R, E] per-row stop ids (-1 pad); remain [R] output-token budget
+    left.  A row that hits EOS or exhausts its budget INSIDE the horizon
+    goes dead on device: its later positions emit masked padding (0), its
+    toks/row_lens freeze, and the one KV slot it keeps rewriting is dead
+    state the host reclaims at finish — the speculative-rollback
+    convention (rejected slots are free to leave dirty).  Every live
+    position computes exactly what the H=1 step computes (same forward,
+    same split-per-step key chain, same fold_in(seed, output_index)
+    stream), so fused output is bit-identical to H=1.
+
     ``mesh`` (static) marks TP serving: op dispatch then emits
     shard_map-wrapped kernels, and its presence in the jit key keeps
     single-device and sharded engines in one process from sharing a trace.
-    Returns (next_tokens [R], cache, key).
+    Returns ([R, H] tokens, [R, H] logprobs, the number of steps actually
+    executed (the horizon early-exits once EVERY row is dead — tail
+    quantization never pays for h-1 dead forwards), cache, and the
+    advanced device state: toks, row_lens, active, steps, remain, key).
     """
     from ipex_llm_tpu.ops import dispatch
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
-    with dispatch.spmd(mesh):
+    def step(n, cache, toks, row_lens, alive, key, steps, remain):
         logits, cache = decoder_forward(
             cfg, params, toks[:, None], cache, row_lens[:, None],
             last_token_only=True, slot_offsets=row_lens,
@@ -203,9 +234,52 @@ def _decode_step(cfg: ModelConfig, params, cache, toks, row_lens, active,
         key, sub = jax.random.split(key)
         nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
                                             seeds=seeds, steps=steps,
-                                            top_ks=top_ks)
-        nxt = jnp.where(active, nxt, 0)
-    return nxt, lp, cache, key
+                                            top_ks=top_ks, active=alive)
+        # on-device early-stop: EOS emission or budget exhaustion kills the
+        # row for the rest of the horizon (it keeps riding the batch fully
+        # masked); the host's _emit walks the same boundary when draining
+        hit_eos = (nxt[:, None] == eos).any(axis=1) & alive
+        adv = alive.astype(jnp.int32)
+        row_lens = row_lens + adv
+        steps = steps + adv
+        remain = remain - adv
+        alive = alive & ~hit_eos & (remain > 0)
+        toks = jnp.where(alive, nxt, toks)
+        return (n + 1, cache, toks, row_lens, alive, key, steps, remain,
+                nxt, lp)
+
+    with dispatch.spmd(mesh):
+        if horizon == 1:
+            # the H=1 program is the loop body inlined — structurally the
+            # same XLA program as the historical single-step decode
+            (n, cache, toks, row_lens, active, key, steps, remain, nxt,
+             lp) = step(jnp.asarray(0, jnp.int32), cache, toks, row_lens,
+                        active, key, steps, remain)
+            tok_block, lp_block = nxt[:, None], lp[:, None]
+        else:
+            r = toks.shape[0]
+
+            def body(carry):
+                n, cache, toks, row_lens, alive, key, steps, remain, tb, \
+                    lb = carry
+                (n1, cache, toks, row_lens, alive, key, steps, remain,
+                 nxt, lp) = step(n, cache, toks, row_lens, alive, key,
+                                 steps, remain)
+                tb = jax.lax.dynamic_update_index_in_dim(tb, nxt, n, 0)
+                lb = jax.lax.dynamic_update_index_in_dim(lb, lp, n, 0)
+                return (n1, cache, toks, row_lens, alive, key, steps,
+                        remain, tb, lb)
+
+            init = (jnp.asarray(0, jnp.int32), cache, toks, row_lens,
+                    active, key, steps, remain,
+                    jnp.zeros((horizon, r), jnp.int32),
+                    jnp.zeros((horizon, r), jnp.float32))
+            (n, cache, toks, row_lens, active, key, steps, remain, tb,
+             lb) = jax.lax.while_loop(
+                lambda c: (c[0] < horizon) & c[4].any(), body, init)
+            tok_block, lp_block = tb.T, lb.T           # [H, R] -> [R, H]
+    return (tok_block, lp_block, n, cache, toks, row_lens, active, steps,
+            remain, key)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"),
@@ -225,8 +299,7 @@ def _pp_decode_sample(cfg: ModelConfig, params, cache, toks, row_lens,
     key, sub = jax.random.split(key)
     nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
                                         seeds=seeds, steps=steps,
-                                        top_ks=top_ks)
-    nxt = jnp.where(active, nxt, 0)
+                                        top_ks=top_ks, active=active)
     return nxt, lp, cache, key
 
 
@@ -244,10 +317,10 @@ def _sample_verify_positions(logits, active, temps, top_ps, key, seeds,
     t_all, lp_all = jax.vmap(
         lambda lg_j, key_j, st_j: sample_rows_with_logprobs(
             lg_j, temps, top_ps, key_j, seeds=seeds, steps=st_j,
-            top_ks=top_ks),
+            top_ks=top_ks, active=active),
         in_axes=(1, 0, 1), out_axes=1,
     )(logits, subkeys, steps_mat)                     # [R, k+1] each
-    return jnp.where(active[:, None], t_all, 0), lp_all, key
+    return t_all, lp_all, key
 
 
 @partial(jax.jit, static_argnames=("cfg", "k", "mesh", "n_micro"),
@@ -372,6 +445,14 @@ class ServingEngine:
                 "in HBM")
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
+        if self.ec.spec_k > 0 and self.ec.decode_horizon > 1:
+            # both widen the step; composing them (speculate inside the
+            # horizon scan) is future work — refuse rather than silently
+            # pick one
+            raise ValueError(
+                "spec_k and decode_horizon are mutually exclusive for now")
+        if self.ec.decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
         self.default_eos = default_eos
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         r = self.ec.max_rows
@@ -420,8 +501,21 @@ class ServingEngine:
         self._inbox: "queue.Queue[Request]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # device-resident hot state (toks / row_lens / active / sampling
+        # params / eos / budgets): uploaded ONLY on epochs — admission,
+        # prefill progress, finish, page allocation — and otherwise carried
+        # forward on device by the fused decode step.  ``_dirty`` marks
+        # that host-side state diverged from the device copies.
+        self._dev: dict[str, jnp.ndarray] | None = None
+        self._dirty = True
         self.metrics = {"requests": 0, "tokens": 0, "steps": 0,
-                        "prefix_hits": 0, "prefix_pages_shared": 0}
+                        "prefix_hits": 0, "prefix_pages_shared": 0,
+                        # host-sync economics (the fused-horizon story):
+                        # decode iterations per blocking device->host sync,
+                        # seconds spent blocked, uploads of row state
+                        "host_syncs": 0, "host_sync_s": 0.0,
+                        "tokens_per_sync": 0.0, "epoch_syncs": 0,
+                        "decode_horizon_effective": 0}
 
     # -- public API ---------------------------------------------------------
 
@@ -462,6 +556,7 @@ class ServingEngine:
                 if pid is None:
                     return False
                 self.tables[row, j] = pid
+                self._dirty = True  # block-table epoch: re-upload tables
         return True
 
     def _release_row_pages(self, row: int):
@@ -470,6 +565,58 @@ class ServingEngine:
             if pid >= 0:
                 self.alloc.decref(pid)
                 self.tables[row, j] = -1
+                self._dirty = True
+
+    # -- device-resident engine state ---------------------------------------
+
+    def _upload_row_state(self):
+        """Upload the per-row hot state after an epoch (admission / prefill
+        progress / finish / page allocation).  Steady-state decode steps
+        skip this entirely and reuse the device arrays the previous fused
+        step returned — request-static sampling params (temps/top_ps/
+        top_ks/seeds) cross the PCIe/tunnel link once per epoch, not once
+        per token (the tier-1 re-upload regression test counts calls)."""
+        rows = self.rows
+        active = np.array([
+            r is not None and i not in self._prefilling
+            for i, r in enumerate(rows)
+        ])
+        steps = np.asarray([len(r.output_ids) if r is not None else 0
+                            for r in rows], np.int32)
+        remain = np.asarray([
+            int(self.row_budget[i]) - len(r.output_ids) if r is not None
+            else 0 for i, r in enumerate(rows)
+        ], np.int32)
+        # per-row EOS ids, -1-padded to a power-of-two width so an unusual
+        # request can only ever trigger a bounded number of fused retraces
+        e_w = max([1] + [len(r.eos_token_id) for r in rows if r is not None])
+        e_w = 1 << (e_w - 1).bit_length()
+        eos = np.full((len(rows), e_w), -1, np.int32)
+        for i, r in enumerate(rows):
+            if r is not None and r.eos_token_id:
+                ids = list(r.eos_token_id)
+                eos[i, :len(ids)] = ids
+        self._dev = {
+            "toks": jnp.asarray(self.toks),
+            "row_lens": jnp.asarray(self.row_lens),
+            "active": jnp.asarray(active),
+            "temps": jnp.asarray(self.temps),
+            "top_ps": jnp.asarray(self.top_ps),
+            "seeds": jnp.asarray(self.seeds),
+            "top_ks": jnp.asarray(self.top_ks),
+            "steps": jnp.asarray(steps),
+            "remain": jnp.asarray(remain),
+            "eos": jnp.asarray(eos),
+        }
+        self.cache = self.cache.with_tables(jnp.asarray(self.tables))
+        self._dirty = False
+
+    def _sync_device_state(self) -> dict:
+        """The device-resident row state, re-uploading only when dirty."""
+        if self._dirty or self._dev is None:
+            self.metrics["epoch_syncs"] += 1
+            self._upload_row_state()
+        return self._dev
 
     # -- engine loop --------------------------------------------------------
 
@@ -547,6 +694,7 @@ class ServingEngine:
             self._prefilling[row] = prompt[base:]
             self._row_keys[row] = keys
             self.metrics["requests"] += 1
+            self._dirty = True  # admission epoch: new row state to upload
 
     def _prefill_one_chunk(self):
         """Advance ONE prefilling row by one chunk (bounded stall)."""
@@ -573,7 +721,7 @@ class ServingEngine:
         toks = np.zeros((1, cp), np.int32)
         toks[0, :n_valid] = chunk
         # uncommitted host array: pjit places it per the compiled sharding
-        cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        cache = self.cache.with_tables(jnp.asarray(self.tables))
         logits, self.cache = _prefill_chunk(
             self.cfg, self.params, cache, jnp.asarray(toks),
             jnp.asarray(self.tables[row : row + 1]),
@@ -581,6 +729,7 @@ class ServingEngine:
             mesh=self.mesh,
         )
         self.row_lens[row] = base + n_valid
+        self._dirty = True  # prefill epoch: row_lens advanced
         if n_valid < len(remaining):
             self._prefilling[row] = remaining[n_valid:]
             return
@@ -635,6 +784,7 @@ class ServingEngine:
         self._prefilling.pop(row, None)
         self._row_keys.pop(row, None)
         self._release_row_pages(row)
+        self._dirty = True  # finish epoch: row freed
 
     def _fail_all(self, exc: BaseException):
         """Engine-level failure: finish every in-flight/queued request so no
@@ -698,7 +848,7 @@ class ServingEngine:
                 valid = d >= 0
                 n_prop[i] = k_req if valid.all() else int(valid.argmin())
                 drafts[i, :k_req] = np.where(valid, d, 0)
-        cache = replace(self.cache, tables=jnp.asarray(self.tables))
+        cache = self.cache.with_tables(jnp.asarray(self.tables))
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)
@@ -714,9 +864,12 @@ class ServingEngine:
             jnp.asarray(self.seeds), jnp.asarray(steps),
             jnp.asarray(self.top_ks), k=k, mesh=self.mesh, **extra,
         )
+        t0 = time.perf_counter()
         t_all, lp_all = np.asarray(t_all), np.asarray(lp_all)
+        self._count_sync(time.perf_counter() - t0)
         self.metrics["steps"] += 1
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
+        self._dirty = True  # host walks acceptance chains: state diverges
         emitted_total = 0
         for i in range(n_rows):
             if not active[i] or self.rows[i] is None:
@@ -753,6 +906,8 @@ class ServingEngine:
         self.metrics["spec_accept_rate"] = round(
             self.metrics["spec_emitted"]
             / ((k + 1) * max(self.metrics["spec_row_steps"], 1)), 4)
+        self.metrics["tokens_per_sync"] = round(
+            self.metrics["tokens"] / self.metrics["host_syncs"], 2)
 
     def _loop(self):
         while not self._stop.is_set():
@@ -787,41 +942,110 @@ class ServingEngine:
         if self.ec.spec_k > 0:
             self._spec_step(active)
             return
-        # allocate the page for this step's KV write (slot row_lens)
+        self._horizon_step(active)
+
+    def _horizon_step(self, active: np.ndarray):
+        """Fused decode: up to ``decode_horizon`` decode+sample steps in one
+        device program, drained token-by-token through ``_emit`` so SSE
+        streaming and finish semantics are exactly the H=1 path's."""
+        H = 1 if self._pp_mode else self.ec.decode_horizon
+        if H > 1 and (self._prefilling or
+                      (not self._inbox.empty()
+                       and self._free_row() is not None)):
+            # streams are still joining (prefilling rows, or arrivals that
+            # raced past this step's _admit with a row free to take them):
+            # fall back to single steps so a joining row never waits out a
+            # horizon and the batch fills at the H=1 engine's pace — the
+            # fused horizon is for steady-state decode, where it amortizes
+            # the host round trip, not for the admission wave, where it
+            # would only delay batching.  A full house with a queue keeps
+            # the full horizon: nothing can admit until a row frees anyway.
+            H = 1
+        # pre-allocate pages for the whole horizon; a tight pool shortens
+        # the horizon for the step (power-of-two buckets bound recompiles)
+        # instead of truncating requests the plain engine could still serve
+        h = H
         for i in range(len(self.rows)):
-            if active[i] and not self._ensure_pages(i, int(self.row_lens[i]) + 1):
+            if not active[i]:
+                continue
+            lens = int(self.row_lens[i])
+            # a near-finished row only reserves what its budget can write —
+            # never H-1 dead slots that could starve another row's ensure
+            # (its post-death masked rewrites route to the scratch page)
+            want = min(H, int(self.row_budget[i])
+                       - len(self.rows[i].output_ids))
+            if self._ensure_pages(i, lens + max(want, 1)):
+                continue
+            backed = (int((self.tables[i] >= 0).sum()) * self.ec.page_size
+                      - lens)
+            if backed < 1:
                 self._finish(i, "length")
                 active[i] = False
+            else:
+                h = min(h, backed)
         if not active.any():
             return
-        cache = replace(self.cache, tables=jnp.asarray(self.tables))
-        steps = np.asarray([
-            len(r.output_ids) if r is not None else 0 for r in self.rows
-        ], np.int32)
-        step_fn, extra = _decode_step, {}
+        if h < H:
+            h = 1 << (h.bit_length() - 1)      # largest power of two <= h
+            self.metrics["horizon_clamped"] = (
+                self.metrics.get("horizon_clamped", 0) + 1)
+        dev = self._sync_device_state()
         if self._pp_mode:
-            step_fn = _pp_decode_sample
-            extra = {"n_micro": self.mesh.shape["pp"]}
-        nxt, lps, self.cache, self.key = step_fn(
-            self.cfg, self.params, cache,
-            jnp.asarray(self.toks), jnp.asarray(self.row_lens),
-            jnp.asarray(active), jnp.asarray(self.temps),
-            jnp.asarray(self.top_ps), self.key,
-            jnp.asarray(self.seeds), jnp.asarray(steps),
-            jnp.asarray(self.top_ks),
-            mesh=self.mesh, **extra,
-        )
-        nxt = np.asarray(nxt)
-        lps = np.asarray(lps)
-        self.metrics["steps"] += 1
+            nxt, lp, self.cache, self.key = _pp_decode_sample(
+                self.cfg, self.params, self.cache, dev["toks"],
+                dev["row_lens"], dev["active"], dev["temps"], dev["top_ps"],
+                self.key, dev["seeds"], dev["steps"], dev["top_ks"],
+                mesh=self.mesh, n_micro=self.mesh.shape["pp"])
+            tok_block, lp_block = nxt[:, None], lp[:, None]
+            # the pp schedule stays H=1 for now (a horizon scan would nest
+            # the GPipe fill/drain per step); it still routes through this
+            # entry but re-uploads per step until it learns the epoch sync
+            self._dirty = True
+            executed = 1
+        else:
+            (tok_block, lp_block, n_exec, self.cache, dev["toks"],
+             dev["row_lens"], dev["active"], dev["steps"], dev["remain"],
+             self.key) = _decode_multi_step(
+                self.cfg, self.params, self.cache, dev["toks"],
+                dev["row_lens"], dev["active"], dev["temps"],
+                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                dev["top_ks"], dev["eos"], dev["remain"],
+                horizon=h, mesh=self.mesh)
+            # the returned cache owns the (donated) tables buffer now
+        t0 = time.perf_counter()
+        tok_block = np.asarray(tok_block)   # THE sync point: h tokens/sync
+        lp_block = np.asarray(lp_block)
+        if not self._pp_mode:
+            executed = int(np.asarray(n_exec))  # < h if every row died early
+        self._count_sync(time.perf_counter() - t0)
+        self.metrics["steps"] += executed
+        self.metrics["decode_horizon_effective"] = h
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
+        self._drain_block(tok_block, lp_block, active, executed)
+        self.metrics["tokens_per_sync"] = round(
+            self.metrics["tokens"] / self.metrics["host_syncs"], 2)
+
+    def _drain_block(self, tok_block, lp_block, active: np.ndarray, h: int):
+        """Walk an [R, h] token/logprob block through the exact per-token
+        emission path: the host stops a row at its EOS/budget/abort
+        boundary, which is the same boundary the device masked at."""
         for i in range(len(self.rows)):
             if not active[i] or self.rows[i] is None:
                 continue
-            self.row_lens[i] += 1
-            tok = int(nxt[i])
-            self.toks[i] = tok
-            self._emit(i, tok, float(lps[i]))
+            for j in range(h):
+                self.row_lens[i] += 1
+                tok = int(tok_block[i, j])
+                self.toks[i] = tok
+                self._emit(i, tok, float(lp_block[i, j]))
+                if self.rows[i] is None:   # finished mid-block
+                    break
+
+    def _count_sync(self, seconds: float):
+        """One blocking device->host materialization (the per-step cost the
+        fused horizon amortizes over H tokens)."""
+        self.metrics["host_syncs"] += 1
+        self.metrics["host_sync_s"] = round(
+            self.metrics["host_sync_s"] + seconds, 6)
 
 
 def stream_tokens(req: Request, timeout: float = 120.0):

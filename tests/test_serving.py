@@ -299,6 +299,11 @@ def test_http_models_and_health(http_server):
     health = json.loads(urllib.request.urlopen(
         f"http://127.0.0.1:{port}/health", timeout=30).read())
     assert health["status"] == "ok"
+    # fused-horizon host-sync economics ride the health payload
+    dec = health["decode"]
+    assert set(dec) == {"tokens_per_sync", "host_sync_s",
+                        "decode_horizon_effective"}
+    assert dec["host_sync_s"] >= 0.0
 
 
 # ---------------------------------------------------------------------------
