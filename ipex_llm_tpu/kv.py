@@ -196,6 +196,18 @@ class PagedKVCache:
         steady-state decode re-uploads nothing."""
         return replace(self, tables=tables)
 
+    def with_table_rows(self, rows: jnp.ndarray,
+                        table_rows: jnp.ndarray) -> "PagedKVCache":
+        """This pool with only ``rows`` of the block table replaced.
+
+        ``rows`` [K] int32 row indices; ``table_rows`` [K, maxP] their new
+        page lists.  A device-side scatter into the resident tables array,
+        so a prefill chunk that grew ONE row's table uploads K*maxP ints
+        instead of re-uploading the whole [R, maxP] table — the serving
+        engine's dirty-row path (every mixed/prefill tick allocates pages
+        for at most the rows it advanced)."""
+        return replace(self, tables=self.tables.at[rows].set(table_rows))
+
     def update_layer(self, kl: jnp.ndarray, vl: jnp.ndarray,
                      new_k: jnp.ndarray, new_v: jnp.ndarray, pos: jnp.ndarray):
         """Scatter new_k/new_v [B, T, H, D] into pool layer [P, H, page, D]

@@ -509,6 +509,7 @@ def decoder_forward(
     collect_obs: int = 0,
     slot_offsets: jnp.ndarray | None = None,  # [B] per-row cache write slots
     input_embeds: jnp.ndarray | None = None,  # [B, T, H] bypasses the lookup
+    gather_positions: jnp.ndarray | None = None,  # [B] per-row logits index
 ):
     """Run the decoder; returns (logits, updated cache).
 
@@ -521,6 +522,14 @@ def decoder_forward(
     ``slot_offsets`` [B] overrides the uniform ``cache.length`` write slot
     with per-row offsets (continuous batching); the returned cache's
     ``length`` is then left untouched — the caller tracks row lengths.
+
+    ``gather_positions`` [B] selects ONE position per row for the logits
+    tail (returns [B, V], like ``last_token_only``) — the serving engine's
+    mixed prefill+decode step, where a ragged right-padded chunk puts each
+    row's last valid token at a different index.  Gathering the hidden
+    state BEFORE the lm head keeps the tail matmul at [B, 1, H] — the same
+    shape (and therefore the same bitwise result) as the T=1 decode step's
+    tail — instead of projecting every pad position.
     """
     from ipex_llm_tpu.ops.embedding import embed_lookup
 
@@ -575,8 +584,12 @@ def decoder_forward(
         # left-padding puts every sequence's last token at T-1; slice BEFORE
         # the norm+head tail so decode steps never project the full window
         x = x[:, -1:, :]
+    elif gather_positions is not None:
+        # ragged chunk: each row's last valid token sits at its own index
+        x = jnp.take_along_axis(
+            x, jnp.clip(gather_positions, 0, t - 1)[:, None, None], axis=1)
     logits = logits_tail(cfg, params, x)
-    if last_token_only:
+    if last_token_only or gather_positions is not None:
         logits = logits[:, 0]
 
     new_len = cache.length if slot_offsets is not None else slot0 + t

@@ -179,7 +179,14 @@ def cached_sdpa(
 
     if hasattr(cache, "tables"):
         # paged pool layer (serving engine; rows right-aligned from slot 0,
-        # queries at slots [kv_len - T, kv_len) — the engine's invariant)
+        # queries at slots [kv_len - T, kv_len) — the engine's invariant).
+        # The mixed prefill+decode step rides this same path with a RAGGED
+        # right-padded chunk: each row's real queries are a PREFIX of its
+        # [kv_len - T, kv_len) window (a decode row has 1, a prefill row up
+        # to T, an idle row 0), and the pad tail past a row's last valid
+        # token reads only scratch-page garbage that causal masking hides
+        # — so one T>1 program serves every row shape in the batch without
+        # per-row dispatch.
         if (
             kwargs.get("bias") is None
             and kwargs.get("window") is None

@@ -95,7 +95,11 @@ def sample_rows_with_logprobs(logits: jnp.ndarray, temps: jnp.ndarray,
     ``seeds`` [R] int32 (-1 = unseeded) with ``steps`` [R] gives rows a
     DETERMINISTIC stream — fold_in(PRNGKey(seed), step) — independent of
     which other requests share the batch; unseeded rows derive per-row
-    keys from the engine's stepping key.
+    keys from the engine's stepping key.  ``step`` is the row's OUTPUT
+    INDEX, so a first token always draws from fold_in(seed, 0) no matter
+    which program samples it — the serving engine's mixed admission step
+    folds first-token sampling into the batched chunk program (steps=0)
+    and reproduces the sequential per-row first-token stream bit-for-bit.
 
     ``active`` [R] bool masks dead rows to (token 0, logprob 0) — ONE
     definition of the serving engines' row masking, shared by the plain,

@@ -320,6 +320,12 @@ class OpenAIServer:
             "tokens_per_sync": m.get("tokens_per_sync", 0.0),
             "host_sync_s": m.get("host_sync_s", 0.0),
             "decode_horizon_effective": m.get("decode_horizon_effective", 0),
+            # admission-wave economics (the mixed prefill+decode step):
+            # mixed ticks run, prompt tokens batched per tick, and the
+            # rolling TTFT p95 the step is sized against
+            "mixed_steps": m.get("mixed_steps", 0),
+            "prefill_tokens_per_step": m.get("prefill_tokens_per_step", 0.0),
+            "ttft_p95_s": m.get("ttft_p95_s", 0.0),
         }
         return web.json_response(body)
 
@@ -524,12 +530,21 @@ def main(argv=None):
                          "device program (one host sync per H tokens; "
                          "streaming granularity becomes up to H tokens; "
                          "mutually exclusive with --speculative)")
+    ap.add_argument("--step-token-budget", type=int, default=None,
+                    metavar="B",
+                    help="mixed prefill+decode step: per-tick token budget "
+                         "during admission waves — prefill chunks for ALL "
+                         "joining requests batch with the decode step in "
+                         "one device program.  Default: the prefill "
+                         "bucket; 0 reverts to sequential one-row-one-"
+                         "chunk admission")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
         EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len,
                      spec_k=args.speculative,
-                     decode_horizon=args.decode_horizon),
+                     decode_horizon=args.decode_horizon,
+                     step_token_budget=args.step_token_budget),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
     )

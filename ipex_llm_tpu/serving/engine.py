@@ -30,7 +30,7 @@ import hashlib
 import queue
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any
@@ -44,6 +44,20 @@ from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
 
 NEG_INF = -1e30
+
+
+def _h2d(x: np.ndarray) -> jnp.ndarray:
+    """Upload a MUTABLE engine-state array, always copying.
+
+    ``jnp.asarray`` on the CPU backend zero-copy-aliases suitably-aligned
+    numpy buffers, and dispatch is async — a program still in flight reads
+    the live buffer AFTER the engine's host-side bookkeeping mutates it
+    (row_lens/temps/tables advance every tick).  Whether a given array
+    aliases depends on where numpy's allocator placed it, so the
+    corruption is alignment- and history-dependent.  ``jnp.array`` (copy
+    semantics) pins a snapshot the device owns.  Fresh per-call arrays
+    that are never mutated may still use ``jnp.asarray``."""
+    return jnp.array(x)
 
 
 @dataclass(frozen=True)
@@ -77,6 +91,19 @@ class EngineConfig:
     # stream contract.  Streaming granularity becomes up to H tokens.
     # Mutually exclusive with spec_k for now (both widen the step).
     decode_horizon: int = 1
+    # mixed prefill+decode step: per-tick prefill token budget for the
+    # admission wave.  While ANY row is prefilling, the engine runs
+    # ``_mixed_step`` — one batched ragged-chunk program advances EVERY
+    # prefilling row (first tokens sampled on device for prompts that
+    # complete), chained with the fused decode program for every active
+    # row in the same tick (the Sarathi-style piggybacked chunked prefill
+    # the TPU ragged-paged-attention serving stacks use).  The budget
+    # fair-shares across joining rows in power-of-two per-row chunk
+    # widths (bounded retraces); decode rows keep their ordinary [R, 1]
+    # step cost.  None = auto (prefill_bucket); 0 disables the mixed step
+    # (the sequential one-row-one-chunk admission path, kept for pp/spec
+    # and as the equivalence baseline).
+    step_token_budget: int | None = None
 
     @property
     def n_pages(self) -> int:
@@ -227,9 +254,20 @@ def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
     def step(n, cache, toks, row_lens, alive, key, steps, remain):
+        # dead/masked rows route their (masked) K/V write to the scratch
+        # page instead of rewriting the slot at their frozen row_lens: a
+        # masked row may be mid-prefill with its DEVICE length stale (the
+        # mixed step advances the host copy between epochs), and a
+        # garbage write at a stale slot would corrupt KV a later chunk
+        # already filled.  Live rows' offsets are untouched, so fused
+        # output stays bit-identical.
+        write_at = jnp.where(
+            alive, row_lens,
+            jnp.asarray(cache.tables.shape[1] * cache.page_size,
+                        jnp.int32))
         logits, cache = decoder_forward(
             cfg, params, toks[:, None], cache, row_lens[:, None],
-            last_token_only=True, slot_offsets=row_lens,
+            last_token_only=True, slot_offsets=write_at,
         )
         key, sub = jax.random.split(key)
         nxt, lp = sample_rows_with_logprobs(logits, temps, top_ps, sub,
@@ -418,6 +456,53 @@ def _prefill_chunk(cfg: ModelConfig, params, cache, tokens, table_row,
     return last, replace(row_cache, tables=cache.tables)
 
 
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(2,))
+def _mixed_prefill_fn(cfg: ModelConfig, params, cache, tokens, base_lens,
+                      n_valid, emit, temps, top_ps, key, seeds, top_ks,
+                      mesh=None):
+    """Batched ragged prefill over the PREFILLING rows: one device program
+    advances every joining row by a chunk, replacing the per-row
+    ``_prefill_chunk`` dispatch loop (O(rows x chunks) tiny programs).
+
+    The caller passes a cache whose ``tables`` view is row-sliced to the
+    prefilling rows (power-of-two padded), so batch position i is
+    prefilling row ``rows[i]``: tokens [P, W] right-padded chunks,
+    base_lens [P] slots already filled (pad rows carry base past the
+    table width so every write routes to the scratch page), n_valid [P]
+    real tokens this tick.  Chunk K/V scatters exactly like the
+    single-row chunk — right-pad garbage lands on the row's own future
+    slots or the scratch page, hidden from valid queries by causal
+    masking — so chunk values are bitwise those of the sequential path.
+
+    ``emit`` marks rows whose prompt completes this tick: their FIRST
+    token is sampled here, on device, from the last valid position
+    (fold_in(seed, 0) for seeded rows — the sequential engine's exact
+    first-token stream), eliminating the per-chunk host sampling round
+    trip.  Returns ([P] tokens, [P] logprobs, cache, key); the host
+    blocks on them only on completion ticks — pure-chunk ticks dispatch
+    without a sync.  Decode rows ride the SAME engine tick through the
+    fused decode program (``_decode_multi_step`` at h=1) dispatched
+    back-to-back on the chained cache: two async dispatches, not
+    2 x rows + 2, and the decode cost stays [R, 1] instead of paying the
+    chunk width per decode token (which would tax compute-bound
+    backends).
+    """
+    from ipex_llm_tpu.ops import dispatch
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+
+    with dispatch.spmd(mesh):
+        pos = base_lens[:, None] + jnp.arange(tokens.shape[1])[None, :]
+        logits, cache = decoder_forward(
+            cfg, params, tokens, cache, pos, slot_offsets=base_lens,
+            gather_positions=jnp.maximum(n_valid - 1, 0),
+        )
+        key, sub = jax.random.split(key)
+        nxt, lp = sample_rows_with_logprobs(
+            logits, temps, top_ps, sub, seeds=seeds,
+            steps=jnp.zeros_like(n_valid), top_ks=top_ks, active=emit)
+    return nxt, lp, cache, key
+
+
 class ServingEngine:
     """Threaded continuous-batching engine around one model."""
 
@@ -453,6 +538,10 @@ class ServingEngine:
                 "spec_k and decode_horizon are mutually exclusive for now")
         if self.ec.decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
+        if (self.ec.step_token_budget is not None
+                and self.ec.step_token_budget < 0):
+            raise ValueError("step_token_budget must be >= 0 (0 disables "
+                             "the mixed prefill+decode step)")
         self.default_eos = default_eos
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         r = self.ec.max_rows
@@ -484,8 +573,25 @@ class ServingEngine:
             and r % pp == 0
             and "layers_dense" not in params
         )
+        # mixed prefill+decode step (admission-wave regime): resolved token
+        # budget per tick; 0 = sequential one-row-one-chunk admission.  The
+        # pp engine keeps the sequential path (the mixed forward would run
+        # GSPMD stage-sequential instead of the GPipe schedule), and spec_k
+        # engines admit sequentially between verify steps.
+        self._step_budget = (self.ec.prefill_bucket
+                             if self.ec.step_token_budget is None
+                             else int(self.ec.step_token_budget))
+        self._mixed_mode = (self._step_budget > 0 and self.ec.spec_k == 0
+                            and not self._pp_mode)
         self.alloc = PageAllocator(self.ec.n_pages)
         self.tables = np.full((r, self.ec.max_pages), -1, np.int32)
+        # block-table dirty-row tracking: every host-side mutation of
+        # ``self.tables`` records its row here, and device syncs scatter
+        # ONLY those rows into the resident tables (kv.with_table_rows)
+        # instead of re-uploading the whole [R, maxP] table per chunk.
+        # PagedKVCache.init and self.tables both start all -1, so host and
+        # device are in sync from construction.
+        self._dirty_tables: set[int] = set()
         self.rows: list[Request | None] = [None] * r
         self.row_lens = np.zeros((r,), np.int32)
         self.row_budget = np.zeros((r,), np.int32)
@@ -499,6 +605,7 @@ class ServingEngine:
         self._row_keys: dict[int, list[bytes]] = {}   # row -> prefix hashes
         self.key = jax.random.PRNGKey(0)
         self._inbox: "queue.Queue[Request]" = queue.Queue()
+        self._work = threading.Event()   # set on submit: idle-loop wakeup
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # device-resident hot state (toks / row_lens / active / sampling
@@ -508,6 +615,9 @@ class ServingEngine:
         # that host-side state diverged from the device copies.
         self._dev: dict[str, jnp.ndarray] | None = None
         self._dirty = True
+        # rolling TTFT window for /health (what the admission-wave mixed
+        # step is judged on)
+        self._ttfts: "deque[float]" = deque(maxlen=128)
         self.metrics = {"requests": 0, "tokens": 0, "steps": 0,
                         "prefix_hits": 0, "prefix_pages_shared": 0,
                         # host-sync economics (the fused-horizon story):
@@ -515,7 +625,13 @@ class ServingEngine:
                         # seconds spent blocked, uploads of row state
                         "host_syncs": 0, "host_sync_s": 0.0,
                         "tokens_per_sync": 0.0, "epoch_syncs": 0,
-                        "decode_horizon_effective": 0}
+                        "decode_horizon_effective": 0,
+                        # admission-wave economics (the mixed-step story):
+                        # mixed ticks run, prompt tokens prefilled per
+                        # tick, dirty-row table syncs, rolling TTFT p95
+                        "mixed_steps": 0, "mixed_prefill_tokens": 0,
+                        "prefill_tokens_per_step": 0.0,
+                        "table_row_syncs": 0, "ttft_p95_s": 0.0}
 
     # -- public API ---------------------------------------------------------
 
@@ -533,6 +649,7 @@ class ServingEngine:
         if not req.eos_token_id:
             req.eos_token_id = self.default_eos
         self._inbox.put(req)
+        self._work.set()
         return req
 
     def abort(self, req: Request):
@@ -556,7 +673,9 @@ class ServingEngine:
                 if pid is None:
                     return False
                 self.tables[row, j] = pid
-                self._dirty = True  # block-table epoch: re-upload tables
+                # page allocation only touches THIS row's table: a dirty-
+                # row scatter sync, not a full row-state epoch
+                self._dirty_tables.add(row)
         return True
 
     def _release_row_pages(self, row: int):
@@ -565,9 +684,18 @@ class ServingEngine:
             if pid >= 0:
                 self.alloc.decref(pid)
                 self.tables[row, j] = -1
-                self._dirty = True
+                self._dirty_tables.add(row)
 
     # -- device-resident engine state ---------------------------------------
+
+    def _active_mask(self) -> np.ndarray:
+        """Rows currently decoding: occupied and past prefill — THE
+        host/device activity predicate; the epoch upload and both
+        scheduler paths must agree on it exactly."""
+        return np.array([
+            r is not None and i not in self._prefilling
+            for i, r in enumerate(self.rows)
+        ])
 
     def _upload_row_state(self):
         """Upload the per-row hot state after an epoch (admission / prefill
@@ -577,10 +705,7 @@ class ServingEngine:
         top_ks/seeds) cross the PCIe/tunnel link once per epoch, not once
         per token (the tier-1 re-upload regression test counts calls)."""
         rows = self.rows
-        active = np.array([
-            r is not None and i not in self._prefilling
-            for i, r in enumerate(rows)
-        ])
+        active = self._active_mask()
         steps = np.asarray([len(r.output_ids) if r is not None else 0
                             for r in rows], np.int32)
         remain = np.asarray([
@@ -597,25 +722,47 @@ class ServingEngine:
                 ids = list(r.eos_token_id)
                 eos[i, :len(ids)] = ids
         self._dev = {
-            "toks": jnp.asarray(self.toks),
-            "row_lens": jnp.asarray(self.row_lens),
+            "toks": _h2d(self.toks),
+            "row_lens": _h2d(self.row_lens),
             "active": jnp.asarray(active),
-            "temps": jnp.asarray(self.temps),
-            "top_ps": jnp.asarray(self.top_ps),
-            "seeds": jnp.asarray(self.seeds),
-            "top_ks": jnp.asarray(self.top_ks),
+            "temps": _h2d(self.temps),
+            "top_ps": _h2d(self.top_ps),
+            "seeds": _h2d(self.seeds),
+            "top_ks": _h2d(self.top_ks),
             "steps": jnp.asarray(steps),
             "remain": jnp.asarray(remain),
             "eos": jnp.asarray(eos),
         }
-        self.cache = self.cache.with_tables(jnp.asarray(self.tables))
+        # tables ride the dirty-row scatter even on full epochs: every
+        # mixed tick is an epoch (row_lens advance), and re-uploading the
+        # whole [R, maxP] table per chunk is the cost this PR removes
+        self._flush_dirty_tables()
         self._dirty = False
 
+    def _flush_dirty_tables(self) -> PagedKVCache:
+        """Scatter only the dirty block-table rows into the device-resident
+        tables (kv.with_table_rows) and return the current cache — the
+        per-chunk full-table re-upload the sequential prefill used to pay,
+        reduced to the rows that actually changed."""
+        if self._dirty_tables:
+            rows = np.array(sorted(self._dirty_tables), np.int32)
+            self.cache = self.cache.with_table_rows(
+                jnp.asarray(rows), jnp.asarray(self.tables[rows]))
+            self.metrics["table_row_syncs"] += 1
+            self._dirty_tables.clear()
+        return self.cache
+
     def _sync_device_state(self) -> dict:
-        """The device-resident row state, re-uploading only when dirty."""
+        """The device-resident row state, re-uploading only when dirty.
+
+        A full epoch (admission / prefill progress / finish) re-uploads the
+        row vectors AND the whole table; a page-allocation-only epoch (mid-
+        decode page boundary) scatters just the dirty table rows."""
         if self._dirty or self._dev is None:
             self.metrics["epoch_syncs"] += 1
             self._upload_row_state()
+        else:
+            self._flush_dirty_tables()
         return self._dev
 
     # -- engine loop --------------------------------------------------------
@@ -666,6 +813,7 @@ class ServingEngine:
                     break
                 self.alloc.addref(pid)
                 self.tables[row, i] = pid
+                self._dirty_tables.add(row)
                 shared += 1
             if shared:
                 self.metrics["prefix_hits"] += 1
@@ -720,11 +868,13 @@ class ServingEngine:
             return
         toks = np.zeros((1, cp), np.int32)
         toks[0, :n_valid] = chunk
-        # uncommitted host array: pjit places it per the compiled sharding
-        cache = self.cache.with_tables(jnp.asarray(self.tables))
+        # dirty-row table sync: only the rows whose tables changed since
+        # the last device call are scattered in (this row's new pages),
+        # not the whole [R, maxP] table per chunk
+        cache = self._flush_dirty_tables()
         logits, self.cache = _prefill_chunk(
             self.cfg, self.params, cache, jnp.asarray(toks),
-            jnp.asarray(self.tables[row : row + 1]),
+            _h2d(self.tables[row : row + 1]),
             jnp.asarray(base, jnp.int32), jnp.asarray(n_valid, jnp.int32),
             mesh=self.mesh,
         )
@@ -733,13 +883,8 @@ class ServingEngine:
         if n_valid < len(remaining):
             self._prefilling[row] = remaining[n_valid:]
             return
-        # prompt complete: register new full pages in the prefix cache,
-        # sample the first token, enter decode
+        # prompt complete: sample the first token, enter decode
         self._prefilling.pop(row, None)
-        n_p = int(self.row_lens[row])
-        keys = self._row_keys.pop(row, [])
-        for i in range(min(len(keys), (n_p - 1) // self.ec.page_size)):
-            self.alloc.register_prefix(keys[i], int(self.tables[row, i]))
         from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
         self.key, sub = jax.random.split(self.key)
@@ -751,10 +896,35 @@ class ServingEngine:
             steps=jnp.zeros((1,), jnp.int32),
             top_ks=jnp.asarray([max(0, int(req.top_k or 0))], jnp.int32),
         )
+        t0 = time.perf_counter()
         first = int(np.asarray(first_t)[0])
+        first_lp = np.asarray(first_lp)
+        self._count_sync(time.perf_counter() - t0)  # blocking materialization
+        self._finish_prompt(row, first, float(first_lp[0]))
+
+    def _finish_prompt(self, row: int, first: int, logprob: float):
+        """Prompt-completion bookkeeping shared by the sequential and
+        mixed admission paths — ONE definition (prefix-page registration
+        bound, TTFT record, first-token emission), so the two paths
+        cannot drift apart under the bit-identity contract."""
+        n_p = int(self.row_lens[row])
+        keys = self._row_keys.pop(row, [])
+        for j in range(min(len(keys), (n_p - 1) // self.ec.page_size)):
+            self.alloc.register_prefix(keys[j], int(self.tables[row, j]))
+        req = self.rows[row]
+        if req is None:
+            return
         req.first_token_s = time.perf_counter() - req.submitted_s
+        self._record_ttft(req.first_token_s)
         self.toks[row] = first
-        self._emit(row, first, float(np.asarray(first_lp)[0]))
+        self._emit(row, first, logprob)
+
+    def _record_ttft(self, seconds: float):
+        """Rolling TTFT percentile for /health (128-request window)."""
+        self._ttfts.append(seconds)
+        self.metrics["ttft_p95_s"] = round(
+            float(np.percentile(np.fromiter(self._ttfts, np.float64), 95)),
+            4)
 
     def _emit(self, row: int, token: int, logprob: float = 0.0):
         req = self.rows[row]
@@ -848,7 +1018,7 @@ class ServingEngine:
                 valid = d >= 0
                 n_prop[i] = k_req if valid.all() else int(valid.argmin())
                 drafts[i, :k_req] = np.where(valid, d, 0)
-        cache = self.cache.with_tables(jnp.asarray(self.tables))
+        cache = self._flush_dirty_tables()
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
         ], np.int32)
@@ -858,11 +1028,11 @@ class ServingEngine:
             extra = {"n_micro": self.mesh.shape["pp"]}
         t_all, lp_all, self.cache, self.key = verify_fn(
             self.cfg, self.params, cache,
-            jnp.asarray(self.toks), jnp.asarray(drafts),
-            jnp.asarray(self.row_lens), jnp.asarray(active),
-            jnp.asarray(self.temps), jnp.asarray(self.top_ps), self.key,
-            jnp.asarray(self.seeds), jnp.asarray(steps),
-            jnp.asarray(self.top_ks), k=k, mesh=self.mesh, **extra,
+            _h2d(self.toks), jnp.asarray(drafts),
+            _h2d(self.row_lens), jnp.asarray(active),
+            _h2d(self.temps), _h2d(self.top_ps), self.key,
+            _h2d(self.seeds), jnp.asarray(steps),
+            _h2d(self.top_ks), k=k, mesh=self.mesh, **extra,
         )
         t0 = time.perf_counter()
         t_all, lp_all = np.asarray(t_all), np.asarray(lp_all)
@@ -921,28 +1091,171 @@ class ServingEngine:
                 self._fail_all(exc)
 
     def _step_once(self):
+        """Scheduler: three regimes.  Admission wave (any row prefilling)
+        → ``_mixed_step`` batches every prefill chunk into one device
+        program and chains the decode step onto the same tick; steady
+        state → the fused decode horizon (unchanged, bit-identical to
+        before); spec_k / pp engines keep the sequential one-row-one-chunk
+        admission path."""
         self._admit()
-        self._prefill_one_chunk()
         for i, req in enumerate(self.rows):  # drop disconnected clients
             if req is not None and req.cancelled:
                 self._finish(i, "abort")
-        active = np.array([
-            r is not None and i not in self._prefilling
-            for i, r in enumerate(self.rows)
-        ])
+        if self._prefilling and self._mixed_mode:
+            self._mixed_step()
+            return
+        self._prefill_one_chunk()
+        active = self._active_mask()
         if not active.any():
             if self._prefilling:
                 return  # keep chunking
-            try:
-                req = self._inbox.get(timeout=0.02)
-                self._inbox.put(req)
-            except queue.Empty:
-                pass
+            self._wait_for_work()
             return
         if self.ec.spec_k > 0:
             self._spec_step(active)
             return
         self._horizon_step(active)
+
+    def _wait_for_work(self, timeout: float = 0.02):
+        """Idle sleep that wakes the moment a request arrives WITHOUT
+        consuming the inbox: the old get()+put() peek rotated the head
+        request behind anything submitted during the peek window, breaking
+        FIFO admission order under a burst.  The event is a pure wakeup
+        hint — clearing it late never loses work, because the next tick's
+        ``_admit`` drains the queue regardless."""
+        if self._inbox.empty():
+            self._work.wait(timeout)
+        self._work.clear()
+
+    def _mixed_step(self):
+        """One admission-wave tick: batched ragged prefill chunks for ALL
+        prefilling rows (one row-sliced device program, first tokens
+        sampled on device for completing prompts) chained with the fused
+        decode step for all active rows — replacing the sequential
+        one-row-one-chunk / decode alternation, which dispatched
+        O(rows x chunks) tiny programs and paid a host sampling round
+        trip plus a full block-table re-upload per chunk.
+
+        Budget split: the per-tick token budget divides across prefilling
+        rows in a power-of-two per-row chunk width (so every joining row
+        advances every tick and the mixed program retraces at most once
+        per width), decode rows ride the ordinary [R, 1] decode program
+        on the same chained cache — one token per tick, the sequential
+        engine's exact pace and program, so their streams stay trivially
+        bit-identical.  Dispatches per tick: two, with at most one
+        blocking sync (the decode block; completion ticks add the
+        first-token fetch)."""
+        if not self._prefilling:
+            return
+        rows = sorted(r for r in self._prefilling
+                      if self.rows[r] is not None)
+        if not rows:
+            return
+        # per-row chunk width: the budget fair-shares across joining rows
+        # (power-of-two floor, capped at the prefill bucket); width
+        # depends only on the row count, so the program set is one trace
+        # per power-of-two batch size.  Floored at 4: slivers of 1-2
+        # tokens per row make the wave tick-bound (per-dispatch overhead
+        # and trace churn dominate), so a huge admission wave briefly
+        # overshoots the budget rather than crawling
+        share = max(1, self._step_budget // len(rows))
+        width = min(max(1 << (share.bit_length() - 1), 4),
+                    self.ec.prefill_bucket)
+        p_b = 1 << (len(rows) - 1).bit_length()        # pow2 batch pad
+
+        toks = np.zeros((p_b, width), np.int32)
+        # pad batch slots carry a base past the table width: every write
+        # they make routes to the scratch page (update_layer's valid mask)
+        base = np.full((p_b,), self.ec.max_pages * self.ec.page_size,
+                       np.int32)
+        n_valid = np.zeros((p_b,), np.int32)
+        emit = np.zeros((p_b,), bool)
+        temps = np.zeros((p_b,), np.float32)
+        top_ps = np.ones((p_b,), np.float32)
+        seeds = np.full((p_b,), -1, np.int32)
+        top_ks = np.zeros((p_b,), np.int32)
+        chunks: list[tuple[int, int, int]] = []  # (slot, row, n_i)
+        for i, row in enumerate(rows):
+            rem = self._prefilling[row]
+            n_i = min(len(rem), width)
+            b = int(self.row_lens[row])
+            if not self._ensure_pages(row, b + n_i):
+                self._finish(row, "error")  # pool exhausted mid-prefill
+                continue
+            req = self.rows[row]
+            toks[i, :n_i] = rem[:n_i]
+            base[i] = b
+            n_valid[i] = n_i
+            emit[i] = n_i == len(rem)      # prompt completes this tick
+            temps[i] = req.temperature
+            top_ps[i] = req.top_p
+            seeds[i] = -1 if req.seed is None else int(req.seed)
+            top_ks[i] = max(0, int(req.top_k or 0))
+            chunks.append((i, row, n_i))
+        if chunks:
+            cache = self._flush_dirty_tables()
+            full_tables = cache.tables
+            row_idx = np.zeros((p_b,), np.int32)
+            row_idx[:len(rows)] = rows
+            # slice the table view to the pages the batch actually uses
+            # (power-of-two bucketed): the jnp fallback gathers each row's
+            # whole table width per layer, so early chunks of a long
+            # prompt would otherwise pay the full-capacity gather; dropped
+            # positions are exactly-masked (zero-probability) slots, so
+            # chunk values stay bitwise identical.  Narrow tables skip the
+            # slicing — the gather saving there is smaller than the cost
+            # of extra program traces per width bucket
+            if self.ec.max_pages > 8:
+                ps = self.ec.page_size
+                maxp_used = max(-(-(int(base[i]) + int(n_valid[i])) // ps)
+                                for i, _, _ in chunks)
+                maxp_b = min(1 << (max(maxp_used, 1) - 1).bit_length(),
+                             self.ec.max_pages)
+            else:
+                maxp_b = self.ec.max_pages
+            sliced = cache.with_tables(
+                full_tables[jnp.asarray(row_idx)][:, :maxp_b])
+            nxt, lp, out, self.key = _mixed_prefill_fn(
+                self.cfg, self.params, sliced, jnp.asarray(toks),
+                jnp.asarray(base), jnp.asarray(n_valid), jnp.asarray(emit),
+                jnp.asarray(temps), jnp.asarray(top_ps), self.key,
+                jnp.asarray(seeds), jnp.asarray(top_ks), mesh=self.mesh)
+            self.cache = out.with_tables(full_tables)
+            # advance bookkeeping; completed prompts run the shared
+            # completion path (_finish_prompt) once their token arrives
+            completing: list[tuple[int, int]] = []   # (slot, row)
+            for i, row, n_i in chunks:
+                self.row_lens[row] += n_i
+                rem = self._prefilling[row]
+                if n_i == len(rem):
+                    self._prefilling.pop(row)
+                    completing.append((i, row))
+                else:
+                    self._prefilling[row] = rem[n_i:]
+            self.metrics["mixed_steps"] += 1
+            self.metrics["mixed_prefill_tokens"] += sum(
+                n for _, _, n in chunks)
+            self.metrics["prefill_tokens_per_step"] = round(
+                self.metrics["mixed_prefill_tokens"]
+                / self.metrics["mixed_steps"], 2)
+            self.metrics["pages_in_use"] = self.alloc.pages_in_use
+            # pure-chunk ticks are NOT an epoch: the decode program masks
+            # prefilling rows and routes their writes to the scratch page,
+            # so their stale device-side lengths are harmless — only a
+            # completion (row joins decode) re-uploads row state
+            if completing:
+                self._dirty = True
+                t0 = time.perf_counter()
+                nxt, lp = np.asarray(nxt), np.asarray(lp)
+                self._count_sync(time.perf_counter() - t0)
+                for i, row in completing:
+                    self._finish_prompt(row, int(nxt[i]), float(lp[i]))
+        # decode rows (including prompts that just completed) ride the
+        # same tick through the ordinary fused decode entry — during a
+        # wave it runs h=1, one token per row per tick
+        active = self._active_mask()
+        if active.any():
+            self._horizon_step(active)
 
     def _horizon_step(self, active: np.ndarray):
         """Fused decode: up to ``decode_horizon`` decode+sample steps in one
