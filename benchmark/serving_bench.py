@@ -118,7 +118,8 @@ def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
 def bench_churn(cfg, params, engine_config, concurrency: int = 4,
                 n_reqs: int = 8, n_out: int = 16,
                 prompt_lens=(24, 48, 72, 96), gap_s: float = 0.05,
-                seed: int = 3) -> dict:
+                seed: int = 3, fault_injector=None,
+                stream_timeout_s: float = 1800.0) -> dict:
     """Admission-churn workload: staggered Poisson-ish arrivals of
     mixed-length prompts with at most ``concurrency`` requests in flight —
     the regime where chunked prefill and in-flight decode contend for the
@@ -127,7 +128,12 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
     alternation cost).  Reports TTFT p50/p95 (the admission-wave number),
     aggregate tok/s across the whole window, and syncs-per-token — the
     dispatch-economics ratio that collapses when the engine alternates
-    tiny per-row programs."""
+    tiny per-row programs.
+
+    ``fault_injector`` (chaos mode, ``--inject-faults``): a scripted
+    ``faults.FaultInjector`` raising transient faults during the window;
+    the row then also reports retries/isolated-error counts and the
+    goodput under fault pressure — the stress-gate numbers."""
     from ipex_llm_tpu.serving.engine import (Request, ServingEngine,
                                              stream_tokens)
 
@@ -136,7 +142,8 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
                                  prompt_lens[i % len(prompt_lens)])
                     .astype(int)) for i in range(n_reqs)]
     gaps = rng.exponential(gap_s, n_reqs)
-    eng = ServingEngine(cfg, params, engine_config).start()
+    eng = ServingEngine(cfg, params, engine_config,
+                        fault_injector=fault_injector).start()
     try:
         # warm every regime the churn will hit: a full-concurrency wave of
         # mixed-length prompts walks the admission path through its
@@ -151,14 +158,21 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
         sem = threading.Semaphore(concurrency)
         reqs: list[Request] = []
         outs: dict[int, list[int]] = {}
+        hangs = [0]
 
         def run_one(i):
             try:
-                outs[i] = list(stream_tokens(reqs[i], timeout=1800))
+                outs[i] = list(stream_tokens(reqs[i],
+                                             timeout=stream_timeout_s))
+            except Exception:
+                hangs[0] += 1   # stream starved past the timeout: a hang
             finally:
                 sem.release()  # a wedged stream must not wedge the bench
 
         m0 = dict(eng.metrics)
+        # window-scope the injector too: warm-up hits its sites as well,
+        # and the gate must count only faults the timed workload absorbed
+        fired0 = fault_injector.fired if fault_injector is not None else 0
         t0 = time.perf_counter()
         threads = []
         for i, p in enumerate(prompts):
@@ -174,14 +188,14 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
             th.start()
             threads.append(th)
         for th in threads:
-            th.join(timeout=1800)
+            th.join(timeout=stream_timeout_s)
         wall = time.perf_counter() - t0
 
         m = eng.metrics
         total_tokens = sum(len(v) for v in outs.values())
         ttfts = [r.first_token_s for r in reqs if r.first_token_s > 0]
         syncs_w = m.get("host_syncs", 0) - m0.get("host_syncs", 0)
-        return {
+        row = {
             "workload": "churn",
             "concurrency": concurrency,
             "n_reqs": n_reqs,
@@ -200,6 +214,20 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
         }
+        if fault_injector is not None:
+            row.update({
+                "workload": "churn+chaos",
+                "faults_injected": fault_injector.fired - fired0,
+                "retries": m.get("retries", 0) - m0.get("retries", 0),
+                "errors_isolated": (m.get("errors_isolated", 0)
+                                    - m0.get("errors_isolated", 0)),
+                # engine-level _fail_all events: any is a stress-gate FAIL
+                "engine_errors": m.get("errors", 0) - m0.get("errors", 0),
+                "failed": sum(1 for r in reqs
+                              if r.finish_reason in ("error", "timeout")),
+                "hangs": hangs[0],
+            })
+        return row
     finally:
         eng.stop()
 
@@ -314,17 +342,89 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
     return out
 
 
+def chaos(cfg=None, params=None, every: int = 5,
+          site: str = "decode-dispatch", n_reqs: int | None = None,
+          stream_timeout_s: float = 300.0) -> tuple[dict, bool]:
+    """Chaos-mode churn (``--inject-faults``): transient faults fire at a
+    deterministic rate (every Nth hit of ``site``) during the churn
+    workload, and the run is a STRESS GATE — it passes only when the
+    fault-domain layer absorbed every injected fault: every request
+    completed (goodput == offered load), zero isolated/engine errors,
+    zero client hangs.  Returns (report_row, passed)."""
+    import jax
+
+    from ipex_llm_tpu.serving.engine import EngineConfig
+    from ipex_llm_tpu.serving.faults import TransientFault, rate_injector
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if cfg is None:
+        from bench import _build_model
+
+        size = os.environ.get("BENCH_SERVE_SIZE",
+                              "7b" if on_tpu else "tiny")
+        cfg, params = _build_model(size, os.environ.get("BENCH_QTYPE",
+                                                        "sym_int4"))
+    n_in = int(os.environ.get("BENCH_SERVE_IN", "256" if on_tpu else "32"))
+    if n_reqs is None:
+        n_reqs = int(os.environ.get("BENCH_CHURN_REQS", "8"))
+    lens = tuple(n_in * k for k in (1, 2, 3, 4))
+    n_out = int(os.environ.get("BENCH_CHURN_OUT", "16"))
+    ec = EngineConfig(
+        max_rows=4,
+        max_seq_len=max(256, 1 << (4 * n_in + n_out).bit_length()),
+        prefill_bucket=min(256, max(32, n_in)),
+        decode_horizon=int(os.environ.get("BENCH_CHURN_HORIZON", "8")),
+        retry_backoff_s=0.005,
+    )
+    injector = rate_injector(site, every, TransientFault, limit=None)
+    row = bench_churn(cfg, params, ec, concurrency=4, n_reqs=n_reqs,
+                      n_out=n_out, prompt_lens=lens,
+                      fault_injector=injector,
+                      stream_timeout_s=stream_timeout_s)
+    row["fault_site"] = site
+    row["fault_every"] = every
+    # the gate: injected transients must be absorbed by retries — any
+    # request-visible error, engine-level failure, incomplete stream, or
+    # hang means the fault domain leaked
+    passed = (row["completed"] == n_reqs
+              and row["failed"] == 0
+              and row["errors_isolated"] == 0
+              and row["engine_errors"] == 0
+              and row["hangs"] == 0
+              and row["faults_injected"] > 0)
+    row["gate"] = "PASS" if passed else "FAIL"
+    return row, passed
+
+
 if __name__ == "__main__":
+    import argparse
     import json
 
     import jax
 
     from bench import _tpu_reachable
 
+    ap = argparse.ArgumentParser("serving benchmark")
+    ap.add_argument("--inject-faults", nargs="?", const=5, type=int,
+                    default=None, metavar="EVERY",
+                    help="chaos mode: inject a transient fault every Nth "
+                         "hit of --fault-site during the churn workload "
+                         "(default every 5th) and exit non-zero unless "
+                         "the fault domain absorbed all of them — no "
+                         "request-visible errors, no hangs")
+    ap.add_argument("--fault-site", default="decode-dispatch",
+                    help="guarded engine site the chaos faults fire at "
+                         "(see ipex_llm_tpu.serving.faults.FAULT_SITES)")
+    args = ap.parse_args()
+
     # probe in a subprocess FIRST: a wedged axon tunnel hangs backend init
     # in-process forever (bench.py:133)
     if not _tpu_reachable(attempts=1, timeout_s=90.0):
         jax.config.update("jax_platforms", "cpu")
     print("backend:", jax.default_backend(), file=sys.stderr)
+    if args.inject_faults is not None:
+        row, passed = chaos(every=args.inject_faults, site=args.fault_site)
+        print(json.dumps(row))
+        sys.exit(0 if passed else 1)
     for row in collect():
         print(json.dumps(row))

@@ -9,5 +9,8 @@ decode step over a fixed row pool; requests join/leave rows between steps
 """
 
 from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from ipex_llm_tpu.serving.faults import (DeterministicFault, EngineOverloaded,
+                                         FaultInjector, TransientFault)
 
-__all__ = ["ServingEngine", "EngineConfig", "Request"]
+__all__ = ["ServingEngine", "EngineConfig", "Request", "FaultInjector",
+           "EngineOverloaded", "TransientFault", "DeterministicFault"]

@@ -23,21 +23,34 @@ except ImportError as _e:  # pragma: no cover
     web = None
     _AIOHTTP_ERR = _e
 
-from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
+                                         ServingEngine, next_stream_item)
+from ipex_llm_tpu.serving.faults import EngineOverloaded
 
 
 def _now() -> int:
     return int(time.time())
 
 
+def _req_failed(req: Request) -> bool:
+    """True when the request's terminal state is a server-side failure the
+    client must see as an error object: an engine fault, an expired
+    deadline, or a server-initiated abort (drain-deadline shed).  A
+    client-initiated abort (``req.cancelled`` — disconnect or stop-string)
+    is not a failure: the client asked for it."""
+    return (req.finish_reason in ("error", "timeout")
+            or (req.finish_reason == "abort" and not req.cancelled))
+
+
 class OpenAIServer:
     def __init__(self, engine: ServingEngine, tokenizer, model_name: str,
-                 asr=None):
+                 asr=None, drain_timeout_s: float = 30.0):
         if web is None:  # pragma: no cover
             raise ImportError(f"aiohttp is required for serving: {_AIOHTTP_ERR}")
         self.engine = engine
         self.tok = tokenizer
         self.model_name = model_name
+        self.drain_timeout_s = drain_timeout_s
         # asr = (whisper model, feature extractor, tokenizer) enabling the
         # OpenAI audio surface (the reference serves whisper through its
         # workers; SURVEY L6 lists the audio endpoint)
@@ -54,6 +67,17 @@ class OpenAIServer:
         if asr is not None:
             self.app.router.add_post("/v1/audio/transcriptions",
                                      self.transcriptions)
+        # graceful drain on SIGTERM/SIGINT: aiohttp's run_app shutdown
+        # sequence awaits on_shutdown before tearing connections down, so
+        # in-flight requests finish inside the drain window while /health
+        # reports "draining"
+        self.app.on_shutdown.append(self._on_shutdown)
+
+    async def _on_shutdown(self, app):
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.engine.drain,
+                                   self.drain_timeout_s)
+        self.engine.stop()
 
     # -- helpers ------------------------------------------------------------
 
@@ -99,21 +123,70 @@ class OpenAIServer:
         hits = [text.find(s) for s in stops if s and text.find(s) >= 0]
         return min(hits) if hits else -1
 
-    # Internal finish reasons: engine "stop" (EOS) / "length" / "abort",
-    # plus server-side "stop_string" for stop-sequence truncation.  The
-    # OpenAI surface maps stop_string -> "stop"; the TGI surface maps
-    # stop -> "eos_token" and stop_string -> "stop_sequence".
+    # Internal finish reasons: engine "stop" (EOS) / "length" / "abort" /
+    # "error" (quarantined or engine failure) / "timeout" (deadline), plus
+    # server-side "stop_string" for stop-sequence truncation.  The OpenAI
+    # surface maps stop_string -> "stop" and surfaces error/timeout — and
+    # a server-initiated abort (drain-deadline shed, _req_failed) — as
+    # JSON error objects (HTTP 500/408/503, or a terminal SSE error
+    # event); the TGI surface maps stop -> "eos_token", stop_string ->
+    # "stop_sequence" and failures to its {"error", "error_type"} shape.
     @staticmethod
     def _openai_reason(fr: str | None) -> str | None:
         return "stop" if fr == "stop_string" else fr
 
-    async def _collect(self, req: Request) -> str:
+    def _submit(self, req: Request) -> Request:
+        """Engine submit with load-shedding mapped onto HTTP: a full
+        bounded queue is 429 (retryable overload), a draining engine is
+        503 (this replica is going away) — both as OpenAI-style error
+        objects with Retry-After."""
+        try:
+            return self.engine.submit(req)
+        except EngineOverloaded as e:
+            body = json.dumps({"error": {
+                "message": str(e),
+                "type": "overloaded_error",
+                "code": "engine_draining" if e.draining else "queue_full",
+                "queue_depth": e.queue_depth,
+            }})
+            cls = (web.HTTPServiceUnavailable if e.draining
+                   else web.HTTPTooManyRequests)
+            raise cls(text=body, content_type="application/json",
+                      headers={"Retry-After": "1"})
+
+    @staticmethod
+    def _error_payload(req: Request) -> dict:
+        if req.finish_reason == "timeout":
+            return {"error": {"message": "request deadline exceeded "
+                                         "(queue wait + generation)",
+                              "type": "timeout_error", "code": "timeout"}}
+        if req.finish_reason == "abort":
+            return {"error": {"message": "request aborted: server "
+                                         "draining (retry elsewhere)",
+                              "type": "unavailable_error",
+                              "code": "server_draining"}}
+        return {"error": {"message": "request failed in the engine "
+                                     "(isolated fault)",
+                          "type": "server_error", "code": "error"}}
+
+    def _error_response(self, req: Request):
+        status = {"timeout": 408, "abort": 503}.get(req.finish_reason, 500)
+        return web.json_response(self._error_payload(req), status=status)
+
+    async def _next_tok(self, req: Request) -> int | None:
+        """One token from the stream queue via the engine's shared
+        dead-engine-detecting fetch (replaces the queue.get-with-no-
+        timeout hang)."""
         loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, next_stream_item,
+                                          self.engine, req)
+
+    async def _collect(self, req: Request) -> str:
         toks: list[int] = []
         drop = set(req.eos_token_id)
         stops = getattr(req, "stop_strings", [])
         while True:
-            tok = await loop.run_in_executor(None, req.stream_queue.get)
+            tok = await self._next_tok(req)
             if tok is None:
                 break
             if tok in drop:
@@ -129,25 +202,27 @@ class OpenAIServer:
         return self.tok.decode(toks)
 
     async def _stream_sse(self, request, req: Request, chunk_fn,
-                          final_fn=None, send_done: bool = True):
+                          final_fn=None, send_done: bool = True,
+                          error_fn=None):
         """Shared SSE streaming loop (OpenAI and TGI surfaces).
 
         ``chunk_fn(piece, finish, tok)`` renders one incremental event;
         ``final_fn(sent_text, finish_reason)`` (optional) renders the
-        terminal event instead of ``chunk_fn("", finish, None)``."""
+        terminal event instead of ``chunk_fn("", finish, None)``;
+        ``error_fn(req)`` (optional) renders the terminal error event for
+        an "error"/"timeout" finish in the surface's own error shape."""
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
         })
         await resp.prepare(request)
-        loop = asyncio.get_running_loop()
         drop = set(req.eos_token_id)
         stops = getattr(req, "stop_strings", [])
         sent = ""
         toks: list[int] = []
         try:
             while True:
-                tok = await loop.run_in_executor(None, req.stream_queue.get)
+                tok = await self._next_tok(req)
                 if tok is None:
                     break
                 if tok in drop:
@@ -169,9 +244,16 @@ class OpenAIServer:
                     self.engine.abort(req)
                     req.finish_reason = "stop_string"
                     break
-            final = (final_fn(sent, req.finish_reason) if final_fn
-                     else chunk_fn("", req.finish_reason, None))
-            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            if _req_failed(req):
+                # terminal error event (the stream already carries a 200
+                # status line; an error object in the stream is the OpenAI
+                # streaming convention)
+                err = (error_fn or self._error_payload)(req)
+                await resp.write(f"data: {json.dumps(err)}\n\n".encode())
+            else:
+                final = (final_fn(sent, req.finish_reason) if final_fn
+                         else chunk_fn("", req.finish_reason, None))
+                await resp.write(f"data: {json.dumps(final)}\n\n".encode())
             if send_done:
                 await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
@@ -191,7 +273,7 @@ class OpenAIServer:
             # constrained decoding runs the offline validator-filtered path
             # (structured.py), bypassing the batch engine
             return await self._chat_json(body, ids)
-        req = self.engine.submit(self._mk_request(body, ids))
+        req = self._submit(self._mk_request(body, ids))
         rid = f"chatcmpl-{req.request_id[:12]}"
 
         if body.get("stream"):
@@ -207,6 +289,8 @@ class OpenAIServer:
             return await self._stream_sse(request, req, chunk)
 
         text = await self._collect(req)
+        if _req_failed(req):
+            return self._error_response(req)
         return web.json_response({
             "id": rid, "object": "chat.completion", "created": _now(),
             "model": self.model_name,
@@ -255,7 +339,7 @@ class OpenAIServer:
         if isinstance(prompt, list):
             prompt = prompt[0]
         ids = list(self.tok(prompt)["input_ids"])
-        req = self.engine.submit(self._mk_request(body, ids))
+        req = self._submit(self._mk_request(body, ids))
         rid = f"cmpl-{req.request_id[:12]}"
 
         if body.get("stream"):
@@ -270,6 +354,8 @@ class OpenAIServer:
             return await self._stream_sse(request, req, chunk)
 
         text = await self._collect(req)
+        if _req_failed(req):
+            return self._error_response(req)
         choice = {"index": 0, "text": text,
                   "finish_reason": self._openai_reason(req.finish_reason)}
         if body.get("logprobs"):
@@ -301,7 +387,9 @@ class OpenAIServer:
     async def health(self, request):
         """Liveness that actually reflects the engine (failure-detection
         surface, SURVEY §5): dead engine thread -> 503; recent step errors
-        surface as degraded."""
+        surface as degraded; a draining engine (SIGTERM received, letting
+        in-flight requests finish) reports "draining" so load balancers
+        stop routing to this replica."""
         thread = self.engine._thread
         if thread is None or not thread.is_alive():
             return web.json_response(
@@ -311,6 +399,8 @@ class OpenAIServer:
         last = self.engine.metrics.get("last_error")
         if last:
             body = {"status": "degraded", "last_error": str(last)}
+        if self.engine.draining:
+            body["status"] = "draining"
         # host-sync economics of the fused decode horizon: tokens emitted
         # per blocking device->host sync, total seconds blocked, and the
         # horizon the last fused step actually ran (page pressure can
@@ -326,6 +416,17 @@ class OpenAIServer:
             "mixed_steps": m.get("mixed_steps", 0),
             "prefill_tokens_per_step": m.get("prefill_tokens_per_step", 0.0),
             "ttft_p95_s": m.get("ttft_p95_s", 0.0),
+        }
+        # fault-domain observability: admission backlog vs the bound (what
+        # a 429 means), per-request failures isolated by bisection,
+        # transient step retries, load-shed and deadline-expired counts
+        body["fault_domain"] = {
+            "queue_depth": self.engine.queue_depth,
+            "max_queue": self.engine.ec.max_queue,
+            "errors_isolated": m.get("errors_isolated", 0),
+            "retries": m.get("retries", 0),
+            "rejected": m.get("rejected", 0),
+            "timeouts": m.get("timeouts", 0),
         }
         return web.json_response(body)
 
@@ -355,10 +456,27 @@ class OpenAIServer:
         return {"stop": "eos_token", "stop_string": "stop_sequence"}.get(
             fr, fr or "length")
 
+    @staticmethod
+    def _tgi_error_payload(req: Request) -> dict:
+        """TGI error shape: flat {"error", "error_type"}."""
+        if req.finish_reason == "timeout":
+            return {"error": "request deadline exceeded",
+                    "error_type": "timeout"}
+        if req.finish_reason == "abort":
+            return {"error": "request aborted: server draining",
+                    "error_type": "unavailable"}
+        return {"error": "request failed in the engine (isolated fault)",
+                "error_type": "generation"}
+
     async def tgi_generate(self, request):
         body = await request.json()
-        req = self.engine.submit(self._tgi_request(body))
+        req = self._submit(self._tgi_request(body))
         text = await self._collect(req)
+        if _req_failed(req):
+            status = {"timeout": 408,
+                      "abort": 503}.get(req.finish_reason, 500)
+            return web.json_response(self._tgi_error_payload(req),
+                                     status=status)
         return web.json_response({
             "generated_text": text,
             "details": {
@@ -370,7 +488,7 @@ class OpenAIServer:
 
     async def tgi_generate_stream(self, request):
         body = await request.json()
-        req = self.engine.submit(self._tgi_request(body))
+        req = self._submit(self._tgi_request(body))
 
         def chunk(piece, finish, tok):
             n = len(req.output_ids)
@@ -386,7 +504,8 @@ class OpenAIServer:
                                 "generated_tokens": len(req.output_ids)}}
 
         return await self._stream_sse(request, req, chunk, final_fn=final,
-                                      send_done=False)
+                                      send_done=False,
+                                      error_fn=self._tgi_error_payload)
 
     # -- audio (whisper) ----------------------------------------------------
 
@@ -462,7 +581,8 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
                  engine_config: EngineConfig | None = None,
                  model=None, tokenizer=None,
                  asr_model_path: str | None = None,
-                 tensor_parallel_size: int = 1) -> OpenAIServer:
+                 tensor_parallel_size: int = 1,
+                 drain_timeout_s: float = 30.0) -> OpenAIServer:
     """``tensor_parallel_size`` > 1 serves under a tp mesh (SPMD AutoTP, the
     reference's vLLM-TP serving mode); a model already ``.shard(mesh)``-ed
     passes its mesh through implicitly."""
@@ -506,7 +626,8 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
             AutoFeatureExtractor.from_pretrained(asr_model_path),
             AutoTokenizer.from_pretrained(asr_model_path),
         )
-    return OpenAIServer(engine, tokenizer, model_name=model_path, asr=asr)
+    return OpenAIServer(engine, tokenizer, model_name=model_path, asr=asr,
+                        drain_timeout_s=drain_timeout_s)
 
 
 def main(argv=None):
@@ -538,15 +659,37 @@ def main(argv=None):
                          "one device program.  Default: the prefill "
                          "bucket; 0 reverts to sequential one-row-one-"
                          "chunk admission")
+    ap.add_argument("--max-queue", type=int, default=256,
+                    help="bounded admission queue: submissions beyond this "
+                         "many waiting requests are load-shed with HTTP "
+                         "429 (0 = unbounded)")
+    ap.add_argument("--request-deadline", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="default per-request wall-clock deadline covering "
+                         "queue wait + generation; an expired request "
+                         "finishes with HTTP 408 (0 = no deadline)")
+    ap.add_argument("--max-step-retries", type=int, default=3,
+                    help="bounded retries (exponential backoff) for "
+                         "transient device faults before the engine "
+                         "bisects and quarantines the culprit request")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="graceful-drain window on SIGTERM: stop admission "
+                         "(503), let in-flight requests finish, then "
+                         "abort stragglers")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
         EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len,
                      spec_k=args.speculative,
                      decode_horizon=args.decode_horizon,
-                     step_token_budget=args.step_token_budget),
+                     step_token_budget=args.step_token_budget,
+                     max_queue=args.max_queue,
+                     request_deadline_s=args.request_deadline,
+                     max_step_retries=args.max_step_retries),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
+        drain_timeout_s=args.drain_timeout,
     )
     web.run_app(srv.app, host=args.host, port=args.port)
 

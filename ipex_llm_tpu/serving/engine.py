@@ -30,6 +30,7 @@ import hashlib
 import queue
 import threading
 import time
+import uuid
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field, replace
 from functools import partial
@@ -42,8 +43,14 @@ import numpy as np
 from ipex_llm_tpu.kv import PagedKVCache
 from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
+from ipex_llm_tpu.serving.faults import (EngineOverloaded, FaultInjector,
+                                         is_transient)
 
 NEG_INF = -1e30
+
+# _bisect_culprit outcome: the fault did not reproduce on re-run (distinct
+# from "engine-level fault", which is None)
+_FAULT_VANISHED = object()
 
 
 def _h2d(x: np.ndarray) -> jnp.ndarray:
@@ -104,6 +111,27 @@ class EngineConfig:
     # (the sequential one-row-one-chunk admission path, kept for pp/spec
     # and as the equivalence baseline).
     step_token_budget: int | None = None
+    # fault domain (PR 3): the unit of failure is a request, not the
+    # engine.  Transient step faults (device preemption, pool pressure,
+    # tunnel hiccups — faults.is_transient) retry up to max_step_retries
+    # times with exponential backoff after rolling host bookkeeping back
+    # to the last committed tick; deterministic faults bisect the tick's
+    # row set and quarantine exactly one culprit row with
+    # finish_reason="error", keeping survivors bit-identical to an
+    # unfaulted run.  _fail_all remains only for faults bisection cannot
+    # localize (engine-level).
+    max_step_retries: int = 3
+    retry_backoff_s: float = 0.02   # base of the exponential backoff
+    # admission control: submit() raises EngineOverloaded once this many
+    # requests are queued (inbox + pending, not counting in-flight rows);
+    # the HTTP surfaces map it to 429.  0 = unbounded (the pre-PR3
+    # behaviour).
+    max_queue: int = 256
+    # default per-request deadline covering queue wait + generation
+    # (Request.deadline_s overrides); enforced at admission (an expired
+    # request finishes "timeout" without ever occupying a row) and at
+    # every emission epoch.  0 = no deadline.
+    request_deadline_s: float = 0.0
 
     @property
     def n_pages(self) -> int:
@@ -146,6 +174,11 @@ class Request:
     # per-request draft width, clamped to EngineConfig.spec_k (the trace
     # width); None = engine default
     spec_k: int | None = None
+    # wall-clock budget covering queue wait + generation, from submission;
+    # None = EngineConfig.request_deadline_s (0 there = no deadline).  An
+    # expired request finishes with finish_reason="timeout" — at admission
+    # without ever occupying a row, or mid-generation at the next tick.
+    deadline_s: float | None = None
 
     def abort(self):
         self.cancelled = True
@@ -509,12 +542,16 @@ class ServingEngine:
     def __init__(self, cfg: ModelConfig, params: dict,
                  engine_config: EngineConfig | None = None,
                  default_eos: tuple[int, ...] = (),
-                 mesh=None):
+                 mesh=None, fault_injector: FaultInjector | None = None):
         """``mesh``: a ``jax.sharding.Mesh`` for TP serving — params are
         placed under the AutoTP rules and the paged pool's kv heads are
         sharded, the reference's vLLM-TP-worker serving mode
         (vllm/xpu/engine/engine.py:40) expressed as SPMD instead of Ray
-        workers.  None = single-chip (the r3 behaviour)."""
+        workers.  None = single-chip (the r3 behaviour).
+
+        ``fault_injector``: a ``faults.FaultInjector`` whose scripted
+        exceptions fire at the engine's guarded sites — the deterministic
+        test/chaos harness for the fault-domain layer."""
         if cfg.rope_2d:
             # chatglm v1 block positions need each row's prompt boundary
             # threaded through every step; generate() supports it, the paged
@@ -605,9 +642,30 @@ class ServingEngine:
         self._row_keys: dict[int, list[bytes]] = {}   # row -> prefix hashes
         self.key = jax.random.PRNGKey(0)
         self._inbox: "queue.Queue[Request]" = queue.Queue()
+        # host-side FIFO the engine thread owns: submissions drain from the
+        # (cross-thread) inbox into this deque, admission pops its head,
+        # and a pool-dry requeue puts the head BACK AT THE HEAD — the old
+        # inbox.put() requeue rotated it behind later arrivals (the same
+        # bug class as the _wait_for_work peek fix).  Being engine-owned
+        # it also checkpoints/rolls back with the rest of the tick state.
+        self._pending: "deque[Request]" = deque()
         self._work = threading.Event()   # set on submit: idle-loop wakeup
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # fault domain: the scripted fault source (tests/chaos bench), the
+        # per-tick emission staging buffer (client-visible queue puts are
+        # deferred until the tick commits, so a rolled-back tick never
+        # leaks a token), requests masked out of the step during bisection
+        # probes, arrivals drained mid-transaction (re-appended on
+        # rollback so they are never lost), and the transient-retry and
+        # drain lifecycle state.
+        self.injector = fault_injector
+        self._staging: list[tuple["queue.Queue", int | None]] | None = None
+        self._masked: set[str] = set()
+        self._tick_arrivals: list[Request] = []
+        self._retries = 0
+        self._draining = False
+        self._drain_abort = threading.Event()
         # device-resident hot state (toks / row_lens / active / sampling
         # params / eos / budgets): uploaded ONLY on epochs — admission,
         # prefill progress, finish, page allocation — and otherwise carried
@@ -631,7 +689,13 @@ class ServingEngine:
                         # tick, dirty-row table syncs, rolling TTFT p95
                         "mixed_steps": 0, "mixed_prefill_tokens": 0,
                         "prefill_tokens_per_step": 0.0,
-                        "table_row_syncs": 0, "ttft_p95_s": 0.0}
+                        "table_row_syncs": 0, "ttft_p95_s": 0.0,
+                        # fault-domain observability: per-request failures
+                        # isolated by bisection, transient step retries,
+                        # load-shed submissions, expired deadlines, and
+                        # the current admission backlog
+                        "errors_isolated": 0, "retries": 0, "rejected": 0,
+                        "timeouts": 0, "queue_depth": 0}
 
     # -- public API ---------------------------------------------------------
 
@@ -646,26 +710,366 @@ class ServingEngine:
             self._thread.join(timeout=30)
 
     def submit(self, req: Request) -> Request:
+        """Enqueue a request; raises ``EngineOverloaded`` when the engine
+        is draining (HTTP surfaces map it to 503) or the bounded queue is
+        full (→ 429) — load shedding instead of unbounded backlog."""
+        if self._draining:
+            self.metrics["rejected"] = self.metrics.get("rejected", 0) + 1
+            raise EngineOverloaded("engine is draining",
+                                   queue_depth=self.queue_depth,
+                                   draining=True)
+        depth = self.queue_depth
+        if self.ec.max_queue and depth >= self.ec.max_queue:
+            self.metrics["rejected"] = self.metrics.get("rejected", 0) + 1
+            raise EngineOverloaded(
+                f"queue full ({depth} requests waiting)", queue_depth=depth)
+        if not req.request_id:
+            # quarantine/bisection and injector scoping key on request_id
+            req.request_id = uuid.uuid4().hex
         if not req.eos_token_id:
             req.eos_token_id = self.default_eos
         self._inbox.put(req)
         self._work.set()
         return req
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a row (inbox + pending, not in-flight)."""
+        return self._inbox.qsize() + len(self._pending)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def abort(self, req: Request):
         """Cancel a request (e.g. client disconnect); its row frees at the
         next step boundary."""
         req.cancelled = True
 
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: reject new submissions (503), let queued and
+        in-flight requests finish, then abort stragglers at the deadline.
+        Returns True when everything finished inside ``timeout``.  The
+        engine thread keeps running (call ``stop()`` afterwards); /health
+        reports "draining" for the duration."""
+        self._draining = True
+
+        def busy():
+            return (any(r is not None for r in self.rows)
+                    or bool(self._pending) or not self._inbox.empty())
+
+        deadline = time.monotonic() + timeout
+        while busy() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        clean = not busy()
+        if not clean:
+            # deadline passed: have the engine thread shed what remains
+            # (rows abort at the next tick boundary, queued requests fail
+            # immediately) — cross-thread state stays engine-owned
+            self._drain_abort.set()
+            self._work.set()
+            hard = time.monotonic() + 10.0
+            while busy() and time.monotonic() < hard:
+                time.sleep(0.01)
+        return clean
+
+    # -- fault domain --------------------------------------------------------
+
+    def _fault_point(self, site: str, rows=(), reqs=()):
+        """Guarded site: the injector may raise here, BEFORE the device or
+        allocator operation the site names, so an injected fault never
+        leaves half-committed device state behind (the recovery contract).
+        ``rows``/``reqs`` name the participating requests — what scopes a
+        poisoned-request spec and what bisection masks."""
+        if self.injector is None:
+            return
+        ids = [r.request_id for r in reqs if r is not None]
+        for i in rows:
+            r = self.rows[i]
+            if r is not None:
+                ids.append(r.request_id)
+        self.injector.hit(site, ids)
+
+    def _queue_put(self, req: Request, item: int | None):
+        """Client-visible emission: staged during a transactional tick and
+        flushed only on commit, so a rolled-back (retried/bisected) tick
+        never leaks a token or a terminal None to a stream consumer."""
+        if self._staging is not None:
+            self._staging.append((req.stream_queue, item))
+        else:
+            req.stream_queue.put(item)
+
+    def _checkpoint(self) -> dict:
+        """Snapshot every piece of host state a tick can mutate — row
+        bookkeeping, the page allocator (free list, refcounts, prefix
+        cache), the pending FIFO, the PRNG key chain, metrics, and the
+        mutable fields of every in-flight/queued Request.  Device state is
+        deliberately NOT snapshotted: the recovery contract is that KV
+        writes beyond the committed ``row_lens`` are scratch (a retried
+        tick rewrites the same slots with the same values), and rollback
+        forces a full epoch re-upload + whole-table rescatter so the
+        device copies converge back to the restored host state."""
+        reqs = [r for r in self.rows if r is not None] + list(self._pending)
+        return {
+            "rows": list(self.rows),
+            "row_lens": self.row_lens.copy(),
+            "row_budget": self.row_budget.copy(),
+            "toks": self.toks.copy(),
+            "temps": self.temps.copy(),
+            "top_ps": self.top_ps.copy(),
+            "seeds": self.seeds.copy(),
+            "top_ks": self.top_ks.copy(),
+            "tables": self.tables.copy(),
+            "prefilling": dict(self._prefilling),
+            "row_keys": dict(self._row_keys),
+            "pending": list(self._pending),
+            "alloc": (list(self.alloc.free), self.alloc.ref.copy(),
+                      OrderedDict(self.alloc.prefix),
+                      dict(self.alloc._page_key)),
+            "key": self.key,
+            "metrics": dict(self.metrics),
+            "ttfts": list(self._ttfts),
+            "reqs": [(r, len(r.output_ids), len(r.logprobs),
+                      r.finish_reason, r.first_token_s) for r in reqs],
+        }
+
+    def _rollback(self, snap: dict):
+        """Restore the checkpoint: the tick never happened.  Staged
+        emissions are discarded (clients saw nothing), arrivals drained
+        mid-tick re-append to the pending FIFO (they were never admitted),
+        and the device copies are marked fully stale."""
+        self._staging = None
+        self.rows = list(snap["rows"])
+        self.row_lens = snap["row_lens"].copy()
+        self.row_budget = snap["row_budget"].copy()
+        self.toks = snap["toks"].copy()
+        self.temps = snap["temps"].copy()
+        self.top_ps = snap["top_ps"].copy()
+        self.seeds = snap["seeds"].copy()
+        self.top_ks = snap["top_ks"].copy()
+        self.tables = snap["tables"].copy()
+        self._prefilling = dict(snap["prefilling"])
+        self._row_keys = dict(snap["row_keys"])
+        free, ref, prefix, pkey = snap["alloc"]
+        self.alloc.free = list(free)
+        self.alloc.ref = ref.copy()
+        self.alloc.prefix = OrderedDict(prefix)
+        self.alloc._page_key = dict(pkey)
+        self.key = snap["key"]
+        # the rolling TTFT window reverts too: a first token recorded by
+        # the doomed tick (or a bisection probe) was never emitted, and the
+        # retried tick will record it again
+        self._ttfts = deque(snap["ttfts"], maxlen=self._ttfts.maxlen)
+        # metrics revert wholesale except the cross-thread counter submit()
+        # bumps (a rejection during the doomed tick really happened)
+        m = dict(snap["metrics"])
+        m["rejected"] = max(self.metrics.get("rejected", 0),
+                            m.get("rejected", 0))
+        self.metrics = m
+        for r, n_out, n_lp, fin, fts in snap["reqs"]:
+            del r.output_ids[n_out:]
+            del r.logprobs[n_lp:]
+            r.finish_reason = fin
+            r.first_token_s = fts
+        self._pending = deque(snap["pending"])
+        for r in self._tick_arrivals:   # drained mid-tick: fresh again
+            r.output_ids.clear()
+            r.logprobs.clear()
+            r.finish_reason = None
+            r.first_token_s = 0.0
+            self._pending.append(r)
+        self._tick_arrivals = []
+        # device copies are now ahead of the restored host state: force a
+        # full row-state epoch AND a whole-table rescatter next dispatch
+        self._dev = None
+        self._dirty = True
+        self._dirty_tables = set(range(self.ec.max_rows))
+
+    def _commit(self):
+        """Flush the tick's staged emissions to the client queues, in
+        emission order — the only point tokens become externally visible."""
+        staged, self._staging = self._staging, None
+        self._tick_arrivals = []
+        for q, item in staged:
+            q.put(item)
+        self.metrics["queue_depth"] = self.queue_depth
+
+    def _tick(self):
+        """ONE transactional engine tick: checkpoint, run the step,
+        commit; on a step fault, roll back (clients saw nothing) and run
+        the recovery policy — transient retry, or bisection + per-request
+        quarantine.  This is the unit of failure isolation.  Returns True
+        when the tick committed cleanly (no recovery ran)."""
+        if self._drain_abort.is_set():
+            self._shed_remaining()
+            self._drain_abort.clear()
+        snap = self._checkpoint()
+        self._staging = []
+        self._tick_arrivals = []
+        try:
+            self._step_once()
+        except Exception as exc:
+            self._rollback(snap)
+            self._recover(exc)
+            return False
+        self._commit()
+        self._retries = 0
+        return True
+
+    def _recover(self, exc: BaseException):
+        """Post-rollback recovery policy.  Transient → bounded exponential
+        backoff, then the loop re-runs the tick from the committed state
+        (same key chain, so the retried tick is bit-identical).  Exhausted
+        retries or deterministic → bisect the participating request set
+        and quarantine the culprit.  Only when bisection cannot localize
+        the fault (it fires with every request masked — an engine-level
+        failure) does ``_fail_all`` run."""
+        if is_transient(exc) and self._retries < self.ec.max_step_retries:
+            self._retries += 1
+            self.metrics["retries"] = self.metrics.get("retries", 0) + 1
+            self._stop.wait(
+                self.ec.retry_backoff_s * (2 ** (self._retries - 1)))
+            return
+        self._retries = 0
+        culprit = self._bisect_culprit()
+        if culprit is _FAULT_VANISHED:
+            # the fault did not reproduce on an immediate re-run: treat it
+            # as transient-resolved and carry on from the committed state
+            self.metrics["last_error"] = f"{type(exc).__name__}: {exc}"
+            return
+        if culprit is None:
+            self._fail_all(exc)     # engine-level: the blast-radius backstop
+            return
+        self._quarantine(culprit, exc)
+
+    def _probe(self, masked_ids: set) -> BaseException | None:
+        """Bisection probe: re-run the tick with ``masked_ids`` sat out
+        (inactive on device, skipped by admission/prefill), emissions
+        muted, and EVERYTHING rolled back afterwards — probes only
+        observe whether the fault fires, they never commit."""
+        snap = self._checkpoint()
+        self._staging = []
+        self._tick_arrivals = []
+        self._masked = set(masked_ids)
+        self._dirty = True   # the active mask changed vs the device copy
+        try:
+            self._step_once()
+            return None
+        except Exception as e:
+            return e
+        finally:
+            self._masked = set()
+            self._rollback(snap)
+
+    def _bisect_culprit(self):
+        """Localize a deterministic fault to ONE request by re-running the
+        tick with suspect subsets masked.  Returns the culprit Request,
+        ``None`` when the fault is engine-level (fires with every suspect
+        masked), or ``_FAULT_VANISHED`` when it does not reproduce."""
+        suspects = [r for r in self.rows if r is not None]
+        suspects += [r for r in self._pending if r not in suspects]
+        if not suspects:
+            return None
+        if self._probe(set()) is None:
+            return _FAULT_VANISHED
+        all_ids = {r.request_id for r in suspects}
+        if self._probe(all_ids) is not None:
+            return None
+        cands = suspects
+        while len(cands) > 1:
+            half = cands[:len(cands) // 2]
+            if self._probe({r.request_id for r in half}) is None:
+                cands = half            # fault silenced → culprit masked
+            else:
+                cands = cands[len(cands) // 2:]
+        culprit = cands[0]
+        # confirm: the culprit alone (everyone else masked) reproduces the
+        # fault — guards against a fault that stopped firing mid-bisection
+        # quarantining an innocent request
+        if self._probe(all_ids - {culprit.request_id}) is None:
+            return _FAULT_VANISHED
+        return culprit
+
+    def _quarantine(self, req: Request, exc: BaseException):
+        """Finish exactly the culprit with ``finish_reason="error"`` —
+        whether it holds a row (pages released) or is still queued — and
+        keep everything else running.  The next tick re-runs without it
+        and commits normally, so survivor streams are bit-identical to an
+        unfaulted run (independent per-row sampling streams)."""
+        self.metrics["errors_isolated"] = (
+            self.metrics.get("errors_isolated", 0) + 1)
+        self.metrics["last_error"] = (
+            f"isolated to request {req.request_id[:12]}: "
+            f"{type(exc).__name__}: {exc}")
+        for i, r in enumerate(self.rows):
+            if r is req:
+                self._finish(i, "error")
+                return
+        try:
+            self._pending.remove(req)
+        except ValueError:
+            pass
+        if req.finish_reason is None:
+            req.finish_reason = "error"
+        req.stream_queue.put(None)
+
+    def _shed_remaining(self):
+        """Drain-deadline enforcement (engine thread): abort whatever is
+        still in flight or queued so ``drain`` can return bounded."""
+        for i, r in enumerate(self.rows):
+            if r is not None:
+                self._finish(i, "abort")
+        self._drain_inbox()
+        while self._pending:
+            req = self._pending.popleft()
+            if req.finish_reason is None:
+                req.finish_reason = "abort"
+            req.stream_queue.put(None)
+        self.metrics["queue_depth"] = self.queue_depth
+
+    def _deadline_of(self, req: Request) -> float | None:
+        d = (req.deadline_s if req.deadline_s is not None
+             else self.ec.request_deadline_s)
+        return d if d and d > 0 else None
+
+    def _expire_deadlines(self):
+        """Finish requests past their wall-clock budget: in-flight rows at
+        this emission epoch, queued requests before they ever occupy a row
+        (admission-time enforcement)."""
+        now = time.perf_counter()
+        for i, r in enumerate(self.rows):
+            if r is None:
+                continue
+            d = self._deadline_of(r)
+            if d is not None and now - r.submitted_s > d:
+                self.metrics["timeouts"] = (
+                    self.metrics.get("timeouts", 0) + 1)
+                self._finish(i, "timeout")
+        if self._pending:
+            keep: "deque[Request]" = deque()
+            for r in self._pending:
+                d = self._deadline_of(r)
+                if d is not None and now - r.submitted_s > d:
+                    self.metrics["timeouts"] = (
+                        self.metrics.get("timeouts", 0) + 1)
+                    if r.finish_reason is None:
+                        r.finish_reason = "timeout"
+                    self._queue_put(r, None)
+                else:
+                    keep.append(r)
+            self._pending = keep
+
     # -- page bookkeeping ----------------------------------------------------
 
-    def _ensure_pages(self, row: int, upto_slot: int) -> bool:
+    def _ensure_pages(self, row: int, upto_slot: int,
+                      req: Request | None = None) -> bool:
         """Allocate pages so slots [0, upto_slot) are backed; False = dry.
 
         ``upto_slot`` past the table width is tolerated: the overflow is
         only ever right-padded prefill slack, which update_layer routes to
         the scratch page (admission caps real tokens at capacity).
         """
+        self._fault_point("page-alloc", rows=(row,), reqs=(req,))
         need = min(-(-upto_slot // self.ec.page_size), self.ec.max_pages)
         for j in range(need):
             if self.tables[row, j] < 0:
@@ -691,9 +1095,12 @@ class ServingEngine:
     def _active_mask(self) -> np.ndarray:
         """Rows currently decoding: occupied and past prefill — THE
         host/device activity predicate; the epoch upload and both
-        scheduler paths must agree on it exactly."""
+        scheduler paths must agree on it exactly.  Rows masked by a
+        bisection probe sit the step out (their device row goes inactive,
+        so the injector never sees them participate)."""
         return np.array([
             r is not None and i not in self._prefilling
+            and r.request_id not in self._masked
             for i, r in enumerate(self.rows)
         ])
 
@@ -773,6 +1180,29 @@ class ServingEngine:
                 return i
         return None
 
+    def _drain_inbox(self):
+        """Move submissions from the cross-thread inbox into the engine-
+        owned pending FIFO.  Arrivals landing mid-transaction are recorded
+        so a rollback re-appends them instead of losing them (the inbox
+        itself is never rolled back)."""
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            self._pending.append(req)
+            if self._staging is not None:
+                self._tick_arrivals.append(req)
+
+    def _pop_pending(self) -> Request | None:
+        """Head of the pending FIFO, skipping bisection-masked requests
+        (a masked suspect stays queued, in order, while a probe runs)."""
+        for i, req in enumerate(self._pending):
+            if req.request_id not in self._masked:
+                del self._pending[i]
+                return req
+        return None
+
     def _admit(self):
         """Join pending requests into free rows (host-side work only —
         prefix matching + page allocation; prefill happens chunk-wise)."""
@@ -780,13 +1210,12 @@ class ServingEngine:
             row = self._free_row()
             if row is None:
                 return
-            try:
-                req = self._inbox.get_nowait()
-            except queue.Empty:
+            req = self._pop_pending()
+            if req is None:
                 return
             if req.cancelled:
                 req.finish_reason = "abort"
-                req.stream_queue.put(None)
+                self._queue_put(req, None)
                 continue
             prompt = np.asarray(req.prompt_ids, np.int32)
             n_p = len(prompt)
@@ -798,7 +1227,7 @@ class ServingEngine:
                            (self.ec.n_pages - 1) * ps)
             if n_p + req.max_new_tokens > capacity or n_p == 0:
                 req.finish_reason = "length"
-                req.stream_queue.put(None)
+                self._queue_put(req, None)
                 continue
 
             # prefix cache: reuse the longest chain of full pages covering
@@ -820,16 +1249,19 @@ class ServingEngine:
                 self.metrics["prefix_pages_shared"] += shared
 
             base = shared * ps
-            if not self._ensure_pages(row, n_p):
+            if not self._ensure_pages(row, n_p, req=req):
                 # pool dry even after eviction: release everything this row
                 # touched (shared refs AND partial fresh allocations)
                 self._release_row_pages(row)
                 if any(r is not None for r in self.rows) or self._prefilling:
-                    self._inbox.put(req)  # retry once in-flight rows free pages
+                    # retry once in-flight rows free pages — AT THE HEAD,
+                    # preserving arrival order (the old inbox.put sent the
+                    # head request behind everything queued after it)
+                    self._pending.appendleft(req)
                 else:
                     # nothing running, nothing evictable: it will never fit
                     req.finish_reason = "length"
-                    req.stream_queue.put(None)
+                    self._queue_put(req, None)
                 return
 
             self.rows[row] = req
@@ -848,7 +1280,13 @@ class ServingEngine:
         """Advance ONE prefilling row by one chunk (bounded stall)."""
         if not self._prefilling:
             return
-        row = next(iter(self._prefilling))
+        # first prefilling row not masked out by a bisection probe (stale
+        # None-row entries still picked so their cleanup path runs)
+        row = next((r for r in self._prefilling
+                    if self.rows[r] is None
+                    or self.rows[r].request_id not in self._masked), None)
+        if row is None:
+            return
         req = self.rows[row]
         if req is None or req.cancelled:
             self._prefilling.pop(row, None)
@@ -868,6 +1306,7 @@ class ServingEngine:
             return
         toks = np.zeros((1, cp), np.int32)
         toks[0, :n_valid] = chunk
+        self._fault_point("prefill-chunk", rows=(row,))
         # dirty-row table sync: only the rows whose tables changed since
         # the last device call are scattered in (this row's new pages),
         # not the whole [R, maxP] table per chunk
@@ -896,6 +1335,7 @@ class ServingEngine:
             steps=jnp.zeros((1,), jnp.int32),
             top_ks=jnp.asarray([max(0, int(req.top_k or 0))], jnp.int32),
         )
+        self._fault_point("sample", rows=(row,))
         t0 = time.perf_counter()
         first = int(np.asarray(first_t)[0])
         first_lp = np.asarray(first_lp)
@@ -933,7 +1373,7 @@ class ServingEngine:
             return
         req.output_ids.append(token)
         req.logprobs.append(logprob)
-        req.stream_queue.put(token)
+        self._queue_put(req, token)
         self.metrics["tokens"] += 1
         if token in req.eos_token_id:
             self._finish(row, "stop")
@@ -947,7 +1387,7 @@ class ServingEngine:
         # overwriting it here would misreport the finish reason
         if req.finish_reason is None:
             req.finish_reason = reason
-        req.stream_queue.put(None)
+        self._queue_put(req, None)
         self.rows[row] = None
         self.row_lens[row] = 0
         self.toks[row] = 0
@@ -957,20 +1397,25 @@ class ServingEngine:
         self._dirty = True  # finish epoch: row freed
 
     def _fail_all(self, exc: BaseException):
-        """Engine-level failure: finish every in-flight/queued request so no
-        client blocks forever, then keep serving."""
+        """Engine-level failure (the blast-radius backstop — reached only
+        when bisection cannot localize a fault to one request, or the
+        recovery machinery itself failed): finish every in-flight/queued
+        request so no client blocks forever, then keep serving."""
+        self._staging = None    # emissions flush directly from here on
+        self._tick_arrivals = []
+        self._masked = set()
         for i, req in enumerate(self.rows):
             if req is not None:
                 self._finish(i, "error")
-        while True:
-            try:
-                req = self._inbox.get_nowait()
-            except queue.Empty:
-                break
-            req.finish_reason = "error"
+        self._drain_inbox()
+        while self._pending:
+            req = self._pending.popleft()
+            if req.finish_reason is None:
+                req.finish_reason = "error"
             req.stream_queue.put(None)
         self.metrics["errors"] = self.metrics.get("errors", 0) + 1
         self.metrics["last_error"] = f"{type(exc).__name__}: {exc}"
+        self.metrics["queue_depth"] = self.queue_depth
 
     def _spec_step(self, active: np.ndarray):
         """One speculative (prompt-lookup verify) step over the active rows."""
@@ -1018,6 +1463,8 @@ class ServingEngine:
                 valid = d >= 0
                 n_prop[i] = k_req if valid.all() else int(valid.argmin())
                 drafts[i, :k_req] = np.where(valid, d, 0)
+        self._fault_point("decode-dispatch",
+                          rows=[i for i in range(n_rows) if active[i]])
         cache = self._flush_dirty_tables()
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
@@ -1082,12 +1529,13 @@ class ServingEngine:
     def _loop(self):
         while not self._stop.is_set():
             try:
-                self._step_once()
-                # a completed step means the engine recovered: clear the
-                # sticky error so /health goes back to "ok"
-                if self.metrics.get("last_error"):
+                committed = self._tick()
+                # a committed tick means the engine recovered: clear the
+                # sticky error so /health goes back to "ok" (the isolated
+                # error lives on in errors_isolated for chaos tooling)
+                if committed and self.metrics.get("last_error"):
                     self.metrics["last_error"] = ""
-            except Exception as exc:  # keep the serving thread alive
+            except Exception as exc:  # recovery machinery itself failed
                 self._fail_all(exc)
 
     def _step_once(self):
@@ -1097,6 +1545,9 @@ class ServingEngine:
         state → the fused decode horizon (unchanged, bit-identical to
         before); spec_k / pp engines keep the sequential one-row-one-chunk
         admission path."""
+        self._drain_inbox()
+        self._expire_deadlines()
+        self.metrics["queue_depth"] = self.queue_depth
         self._admit()
         for i, req in enumerate(self.rows):  # drop disconnected clients
             if req is not None and req.cancelled:
@@ -1148,8 +1599,14 @@ class ServingEngine:
         if not self._prefilling:
             return
         rows = sorted(r for r in self._prefilling
-                      if self.rows[r] is not None)
+                      if self.rows[r] is not None
+                      and self.rows[r].request_id not in self._masked)
         if not rows:
+            # every prefilling row is masked by a bisection probe: the
+            # decode rows (if any) still take their step below
+            active = self._active_mask()
+            if active.any():
+                self._horizon_step(active)
             return
         # per-row chunk width: the budget fair-shares across joining rows
         # (power-of-two floor, capped at the prefill bucket); width
@@ -1193,6 +1650,7 @@ class ServingEngine:
             top_ks[i] = max(0, int(req.top_k or 0))
             chunks.append((i, row, n_i))
         if chunks:
+            self._fault_point("mixed-step", rows=[r for _, r, _ in chunks])
             cache = self._flush_dirty_tables()
             full_tables = cache.tables
             row_idx = np.zeros((p_b,), np.int32)
@@ -1245,6 +1703,8 @@ class ServingEngine:
             # completion (row joins decode) re-uploads row state
             if completing:
                 self._dirty = True
+                self._fault_point("sample",
+                                  rows=[row for _, row in completing])
                 t0 = time.perf_counter()
                 nxt, lp = np.asarray(nxt), np.asarray(lp)
                 self._count_sync(time.perf_counter() - t0)
@@ -1302,6 +1762,9 @@ class ServingEngine:
             h = 1 << (h.bit_length() - 1)      # largest power of two <= h
             self.metrics["horizon_clamped"] = (
                 self.metrics.get("horizon_clamped", 0) + 1)
+        self._fault_point("decode-dispatch",
+                          rows=[i for i in range(len(self.rows))
+                                if active[i]])
         dev = self._sync_device_state()
         if self._pp_mode:
             nxt, lp, self.cache, self.key = _pp_decode_sample(
@@ -1361,8 +1824,32 @@ class ServingEngine:
             self.metrics["host_sync_s"] + seconds, 6)
 
 
-def stream_tokens(req: Request, timeout: float = 120.0):
-    """Yield tokens from a submitted request until completion."""
+def next_stream_item(engine: "ServingEngine", req: Request,
+                     poll_s: float = 0.5) -> int | None:
+    """Blocking fetch of one stream item, waiting in bounded slices so a
+    dead engine thread fails the request (``finish_reason="error"``)
+    instead of hanging the consumer forever.  The shared dead-engine
+    detection protocol for every HTTP frontend — returns the next token,
+    or None at end of stream / engine death."""
+    while True:
+        try:
+            return req.stream_queue.get(timeout=poll_s)
+        except queue.Empty:
+            t = engine._thread
+            if t is None or not t.is_alive():
+                if req.finish_reason is None:
+                    req.finish_reason = "error"
+                return None
+
+
+def stream_tokens(req: Request, timeout: float | None = None):
+    """Yield tokens from a submitted request until completion.
+
+    ``timeout`` is the max wait between tokens; None aligns it with the
+    request's own deadline (plus grace for the engine's timeout tick to
+    land) when one is set, else the historical 120 s."""
+    if timeout is None:
+        timeout = (req.deadline_s + 30.0) if req.deadline_s else 120.0
     while True:
         tok = req.stream_queue.get(timeout=timeout)
         if tok is None:

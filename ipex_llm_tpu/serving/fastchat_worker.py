@@ -22,9 +22,17 @@ import uuid
 
 from aiohttp import web
 
-from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
+from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
+                                         ServingEngine, next_stream_item)
+from ipex_llm_tpu.serving.faults import EngineOverloaded
 
 HEARTBEAT_INTERVAL_S = 45.0
+
+# FastChat protocol error codes (fastchat.constants.ErrorCode peers): the
+# controller retries another worker on 50301/50302; 50001 is internal.
+ERROR_CODE_INTERNAL = 50001
+ERROR_CODE_OVERLOADED = 50302
+ERROR_CODE_TIMEOUT = 50300
 
 
 class FastChatWorker:
@@ -32,8 +40,10 @@ class FastChatWorker:
                  controller_addr: str | None = None,
                  worker_addr: str = "http://localhost:21002",
                  limit_worker_concurrency: int = 8,
-                 engine_config: EngineConfig | None = None):
+                 engine_config: EngineConfig | None = None,
+                 drain_timeout_s: float = 30.0):
         self.tok = tokenizer
+        self.drain_timeout_s = drain_timeout_s
         self.model_names = model_names
         self.controller_addr = controller_addr
         self.worker_addr = worker_addr
@@ -60,10 +70,24 @@ class FastChatWorker:
             web.post("/model_details", self.api_model_details),
             web.post("/worker_get_conv_template", self.api_conv_template),
         ])
+        # graceful drain on SIGTERM (reference workers restart-on-error;
+        # here the replica finishes in-flight requests before exiting)
+        self.app.on_shutdown.append(self._on_shutdown)
+
+    async def _on_shutdown(self, app):
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.engine.drain,
+                                   self.drain_timeout_s)
+        self.engine.stop()
 
     # -- controller protocol ------------------------------------------------
 
     def status(self) -> dict:
+        # queue_length feeds the controller's least-loaded routing.
+        # in_flight counts each stream for its WHOLE lifetime — engine
+        # queue wait included — so adding engine.queue_depth would count
+        # queued requests twice and make this worker look busier than it
+        # is.
         return {"model_names": self.model_names, "speed": 1,
                 "queue_length": self.in_flight}
 
@@ -119,20 +143,36 @@ class FastChatWorker:
         )
         return req, len(ids)
 
+    async def _next_tok(self, req: Request) -> int | None:
+        """Bounded-wait token fetch via the engine's shared dead-engine-
+        detecting protocol: fails the request with an error chunk instead
+        of hanging the client."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, next_stream_item,
+                                          self.engine, req)
+
     async def _stream_chunks(self, params: dict):
         """Yield the protocol's cumulative-text JSON chunks."""
         self.call_ct += 1
         self.in_flight += 1
-        loop = asyncio.get_running_loop()
         req = None
         try:
             req, n_in = self._make_request(params)
             echo = bool(params.get("echo", True))
             base = params["prompt"] if echo else ""
-            self.engine.submit(req)
+            try:
+                self.engine.submit(req)
+            except EngineOverloaded as e:
+                # load-shed in the protocol's own shape: a non-zero
+                # error_code chunk makes the controller retry elsewhere
+                req = None
+                yield {"text": f"worker overloaded: {e}",
+                       "error_code": ERROR_CODE_OVERLOADED,
+                       "finish_reason": "abort"}
+                return
             toks: list[int] = []
             while True:
-                tok = await loop.run_in_executor(None, req.stream_queue.get)
+                tok = await self._next_tok(req)
                 if tok is None:
                     break
                 toks.append(tok)
@@ -145,6 +185,21 @@ class FastChatWorker:
                               "total_tokens": n_in + len(toks)},
                     "finish_reason": None,
                 }
+            shed = req.finish_reason == "abort" and not req.cancelled
+            if req.finish_reason in ("error", "timeout") or shed:
+                # drain-deadline shed surfaces as overloaded (non-zero
+                # error_code -> the controller retries another worker),
+                # never as a 200 with truncated text
+                text, code = {
+                    "timeout": ("request deadline exceeded",
+                                ERROR_CODE_TIMEOUT),
+                    "abort": ("worker draining: request aborted",
+                              ERROR_CODE_OVERLOADED),
+                }.get(req.finish_reason,
+                      ("request failed in the engine", ERROR_CODE_INTERNAL))
+                yield {"text": text, "error_code": code,
+                       "finish_reason": req.finish_reason}
+                return
             yield {
                 "text": base + self.tok.decode(toks, skip_special_tokens=True),
                 "error_code": 0,
@@ -200,7 +255,8 @@ def build_worker(model_path: str, low_bit: str = "sym_int4",
                  controller_addr: str | None = None,
                  worker_addr: str = "http://localhost:21002",
                  model_names: list[str] | None = None,
-                 limit_worker_concurrency: int = 8) -> FastChatWorker:
+                 limit_worker_concurrency: int = 8,
+                 drain_timeout_s: float = 30.0) -> FastChatWorker:
     from transformers import AutoTokenizer
 
     from ipex_llm_tpu.transformers import AutoModelForCausalLM
@@ -210,7 +266,8 @@ def build_worker(model_path: str, low_bit: str = "sym_int4",
     tok = AutoTokenizer.from_pretrained(model_path, trust_remote_code=True)
     names = model_names or [model_path.rstrip("/").split("/")[-1]]
     return FastChatWorker(model, tok, names, controller_addr, worker_addr,
-                          limit_worker_concurrency)
+                          limit_worker_concurrency,
+                          drain_timeout_s=drain_timeout_s)
 
 
 def main(argv=None):
@@ -224,12 +281,17 @@ def main(argv=None):
     ap.add_argument("--model-names", default=None)
     ap.add_argument("--limit-worker-concurrency", type=int, default=8)
     ap.add_argument("--no-register", action="store_true")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="graceful-drain window on SIGTERM: stop admission, "
+                         "finish in-flight requests, abort stragglers")
     args = ap.parse_args(argv)
     worker_addr = args.worker_address or f"http://localhost:{args.port}"
     names = args.model_names.split(",") if args.model_names else None
     w = build_worker(args.model_path, args.low_bit,
                      None if args.no_register else args.controller_address,
-                     worker_addr, names, args.limit_worker_concurrency)
+                     worker_addr, names, args.limit_worker_concurrency,
+                     drain_timeout_s=args.drain_timeout)
     if w.controller_addr:
         async def on_start(app):
             app["hb"] = asyncio.create_task(w.heartbeat_loop())
