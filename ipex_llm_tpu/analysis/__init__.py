@@ -1,0 +1,28 @@
+"""jaxlint — JAX-aware static analysis for this repo's hazard classes.
+
+Usage::
+
+    python -m ipex_llm_tpu.analysis [paths...]       # human output
+    python -m ipex_llm_tpu.analysis --json ipex_llm_tpu/
+    scripts/jaxlint ipex_llm_tpu/                     # same thing
+
+Programmatic::
+
+    from ipex_llm_tpu.analysis import analyze_paths, analyze_source
+    findings = analyze_paths(["ipex_llm_tpu/"])
+
+The rule catalog lives in ``ipex_llm_tpu/analysis/rules/`` and the long
+form in ``docs/quickstart/static_analysis.md``.  Zero unsuppressed
+error-tier findings over ``ipex_llm_tpu/`` is a tier-1 gate
+(``tests/test_static_analysis.py``).
+"""
+
+from ipex_llm_tpu.analysis.config import Config, DEFAULT_CONFIG, relkey
+from ipex_llm_tpu.analysis.core import (Finding, all_rules, analyze_paths,
+                                        analyze_source, counts, exit_code,
+                                        to_json)
+
+__all__ = [
+    "Config", "DEFAULT_CONFIG", "Finding", "all_rules", "analyze_paths",
+    "analyze_source", "counts", "exit_code", "relkey", "to_json",
+]

@@ -1,0 +1,59 @@
+"""CLI for jaxlint: ``python -m ipex_llm_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (warnings allowed), 1 unsuppressed error-tier
+findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from ipex_llm_tpu.analysis import core
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="JAX-aware static analysis: host/device aliasing, "
+                    "hidden syncs, recompile hazards, tracer leaks, "
+                    "PRNG misuse.")
+    ap.add_argument("paths", nargs="*", default=["ipex_llm_tpu"],
+                    help="files or directories to lint "
+                         "(default: ipex_llm_tpu)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report (stable schema v%d)"
+                         % core.SCHEMA_VERSION)
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in human output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(core.all_rules().values(), key=lambda r: r.code):
+            print(f"{rule.code}  {rule.name:<22} [{rule.severity:<5}] "
+                  f"{rule.doc}")
+        return 0
+
+    # a typo'd path (or running from the wrong cwd) must not pass the
+    # gate green by linting zero files
+    missing = [p for p in args.paths if not Path(p).exists()]
+    files = [str(f) for f in core.iter_py_files(args.paths)]
+    if missing or not files:
+        what = (f"path(s) do not exist: {', '.join(missing)}" if missing
+                else f"no .py files found under: {', '.join(args.paths)}")
+        print(f"jaxlint: {what}", file=sys.stderr)
+        return 2
+
+    findings = core.analyze_paths(files)
+    if args.json:
+        print(core.to_json(findings))
+    else:
+        core.render_human(findings, show_suppressed=args.show_suppressed)
+    return core.exit_code(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

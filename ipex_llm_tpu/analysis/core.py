@@ -1,0 +1,316 @@
+"""jaxlint core: findings, rule registry, suppressions, runner, reports.
+
+The analyzer is a tier-1 gate (``tests/test_static_analysis.py``): a new
+unsuppressed error-tier finding anywhere in ``ipex_llm_tpu/`` fails CI.
+Suppressions are therefore *loud*: every ``jaxlint: disable=CODE``
+comment must carry a written reason (``-- why it is safe``); one without
+a reason is itself an error (JL000), so the inventory of waived hazards
+stays reviewable.  A suppression on its own line covers the statement
+starting on the next line; one trailing a statement covers that whole
+statement (all its lines, so multi-line calls work).  Only real COMMENT
+tokens count — a marker inside a string literal is data.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import sys
+import tokenize
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.config import Config, DEFAULT_CONFIG, relkey
+
+SCHEMA_VERSION = 1
+
+ERROR = "error"
+WARN = "warn"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str            # "error" | "warn"
+    path: str                # repo-anchored key (config.relkey)
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str | None = None    # suppression reason, when suppressed
+
+    def render(self) -> str:
+        sup = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}{sup}")
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str                # "JL001"
+    name: str                # "aliasing-upload"
+    severity: str            # default tier
+    doc: str                 # one-line description (shown in --list-rules)
+    check: Callable[["ModuleCtx", Config], Iterator[Finding]]
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(code: str, name: str, severity: str, doc: str):
+    """Decorator: register ``fn(ctx, config) -> iterator of findings``."""
+    def deco(fn):
+        _REGISTRY[code] = Rule(code, name, severity, doc, fn)
+        return fn
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    from ipex_llm_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+    return dict(_REGISTRY)
+
+
+@dataclass
+class ModuleCtx:
+    """Everything a rule needs about one source file."""
+    path: str                        # as given
+    key: str                         # repo-anchored (config.relkey)
+    source: str
+    tree: ast.Module
+    aliases: dict[str, str]
+    lines: list[str] = field(default_factory=list)
+
+    @classmethod
+    def from_source(cls, source: str, path: str) -> "ModuleCtx":
+        tree = ast.parse(source, filename=path)
+        return cls(path=path, key=relkey(path), source=source, tree=tree,
+                   aliases=astutil.import_aliases(tree),
+                   lines=source.splitlines())
+
+    def finding(self, rule: str, severity: str, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=rule, severity=severity, path=self.key,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message)
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+# marker that a line is *trying* to be a suppression (malformed or not)
+_SUPPRESS_MARK = re.compile(r"#\s*jaxlint:\s*disable")
+# the well-formed shape: "# jaxlint: disable=JL001,JL002 -- reason text"
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9,\s]+?)\s*(?:--\s*(.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Suppression:
+    line: int                    # the comment's own line
+    codes: tuple[str, ...]
+    reason: str | None
+    span: tuple[int, int] = (0, 0)   # lines covered (inclusive)
+
+    def covers(self, line: int) -> bool:
+        return self.span[0] <= line <= self.span[1]
+
+
+def _stmt_spans(tree: ast.Module) -> list[tuple[int, int]]:
+    """(lineno, end_lineno) of every statement, headers-only for compounds.
+
+    Findings anchor to the line their AST node *starts* on, but a
+    trailing suppression comment sits on the line the statement *ends*
+    on — for a multi-line call those differ, so suppression coverage
+    must span the whole statement.  Compound statements (if/for/while/
+    with) contribute only their header span: a comment trailing an
+    ``if cond:`` line must not blanket the body.
+    """
+    spans: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        if isinstance(node, (ast.If, ast.While)):
+            hdr = node.test
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            hdr = node.iter
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            hdr = node.items[-1].context_expr
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Try)):
+            continue
+        else:
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+            continue
+        spans.append((node.lineno, hdr.end_lineno or node.lineno))
+    return spans
+
+
+def _coverage(spans: list[tuple[int, int]], line: int,
+              standalone: bool) -> tuple[int, int]:
+    if standalone:
+        # covers the statement STARTING on the next line (full span)
+        nxt = [s for s in spans if s[0] == line + 1]
+        return min(nxt, key=lambda s: s[1]) if nxt else (line + 1, line + 1)
+    # trailing: covers the innermost statement containing this line
+    hit = [s for s in spans if s[0] <= line <= s[1]]
+    return max(hit, key=lambda s: (s[0], -s[1])) if hit else (line, line)
+
+
+def _iter_comments(ctx: ModuleCtx) -> Iterator[tuple[int, str, bool]]:
+    """(line, comment_text, standalone) for every real COMMENT token.
+
+    Tokenizing (rather than regex-scanning raw lines) means a
+    ``jaxlint: disable`` marker inside a string literal or docstring is
+    just data — it can neither suppress a genuine finding on its line
+    nor fail the gate as a malformed suppression (JL000).
+    """
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(ctx.source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield (tok.start[0], tok.string,
+                       not tok.line[:tok.start[1]].strip())
+    except tokenize.TokenError:
+        return   # unterminated construct past the last comment; AST parsed
+
+
+def parse_suppressions(ctx: ModuleCtx) -> tuple[list[Suppression], list[Finding]]:
+    """Per-line suppressions + JL000 findings for malformed ones."""
+    sups: list[Suppression] = []
+    bad: list[Finding] = []
+    known = set(all_rules())
+    spans = _stmt_spans(ctx.tree)
+    for i, text, standalone in _iter_comments(ctx):
+        if not _SUPPRESS_MARK.search(text):
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            bad.append(Finding("JL000", ERROR, ctx.key, i, 1,
+                               "malformed jaxlint suppression (expected "
+                               "'jaxlint: disable=CODE -- reason')"))
+            continue
+        codes = tuple(c.strip().upper() for c in m.group(1).split(",")
+                      if c.strip())
+        reason = m.group(2)
+        if not reason:
+            bad.append(Finding("JL000", ERROR, ctx.key, i, 1,
+                               f"suppression of {','.join(codes)} has no "
+                               "reason — append '-- why this is safe'"))
+            continue
+        unknown = [c for c in codes if c not in known]
+        if unknown:
+            bad.append(Finding("JL000", ERROR, ctx.key, i, 1,
+                               f"suppression names unknown rule(s) "
+                               f"{','.join(unknown)}"))
+        sups.append(Suppression(i, codes, reason,
+                                span=_coverage(spans, i, standalone)))
+    return sups, bad
+
+
+def apply_suppressions(findings: list[Finding],
+                       sups: list[Suppression]) -> list[Finding]:
+    out: list[Finding] = []
+    for f in findings:
+        s = next((s for s in sups
+                  if s.covers(f.line) and f.rule in s.codes), None)
+        if s:
+            out.append(Finding(**{**asdict(f), "suppressed": True,
+                                  "reason": s.reason}))
+        else:
+            out.append(f)
+    return out
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+
+def analyze_source(source: str, path: str,
+                   config: Config = DEFAULT_CONFIG) -> list[Finding]:
+    """Lint one source string as if it lived at ``path``."""
+    try:
+        ctx = ModuleCtx.from_source(source, path)
+    except SyntaxError as e:
+        return [Finding("JL000", ERROR, relkey(path), e.lineno or 1, 1,
+                        f"syntax error: {e.msg}")]
+    sups, bad = parse_suppressions(ctx)
+    findings: list[Finding] = list(bad)
+    for rule in all_rules().values():
+        if rule.code == "JL000":
+            continue
+        for f in rule.check(ctx, config):
+            sev = config.severity_for(ctx.key, f.rule, f.severity)
+            if sev != f.severity:
+                f = Finding(**{**asdict(f), "severity": sev})
+            findings.append(f)
+    findings = apply_suppressions(findings, sups)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    # overlapping scope walks (e.g. a def nested in a traced def) can
+    # report one site twice — collapse exact duplicates
+    seen: set[tuple] = set()
+    deduped: list[Finding] = []
+    for f in findings:
+        k = (f.rule, f.path, f.line, f.col)
+        if k not in seen:
+            seen.add(k)
+            deduped.append(f)
+    return deduped
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[Path]:
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            yield from sorted(pp.rglob("*.py"))
+        elif pp.suffix == ".py":
+            yield pp
+
+
+def analyze_paths(paths: Iterable[str],
+                  config: Config = DEFAULT_CONFIG) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(analyze_source(
+            f.read_text(encoding="utf-8"), str(f), config))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# reports
+# --------------------------------------------------------------------------
+
+def counts(findings: list[Finding]) -> dict[str, int]:
+    live = [f for f in findings if not f.suppressed]
+    return {
+        "errors": sum(1 for f in live if f.severity == ERROR),
+        "warnings": sum(1 for f in live if f.severity == WARN),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+    }
+
+
+def to_json(findings: list[Finding]) -> str:
+    return json.dumps({
+        "version": SCHEMA_VERSION,
+        "counts": counts(findings),
+        "findings": [asdict(f) for f in findings],
+    }, indent=2)
+
+
+def render_human(findings: list[Finding], show_suppressed: bool = False,
+                 out=sys.stdout) -> None:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    for f in shown:
+        print(f.render(), file=out)
+    c = counts(findings)
+    print(f"jaxlint: {c['errors']} error(s), {c['warnings']} warning(s), "
+          f"{c['suppressed']} suppressed", file=out)
+
+
+def exit_code(findings: list[Finding]) -> int:
+    """0 = clean (warnings allowed), 1 = unsuppressed error-tier findings."""
+    return 1 if counts(findings)["errors"] else 0

@@ -1,0 +1,79 @@
+"""JL001 aliasing-upload: the PR 2 stream-corruption race, as a rule.
+
+In a module that dispatches asynchronously (the serving engine, the
+multimodal/speculative generation loops, the expert offload store), a
+zero-copy upload — ``jnp.asarray`` / ``jax.device_put`` on a host
+buffer — hands the device a *live view* of memory the host may mutate
+while the program is still in flight.  Whether a given numpy array
+actually aliases depends on allocator placement, so the corruption is
+alignment- and history-dependent.
+
+The contract this rule enforces: inside the configured async-dispatch
+modules, ``jnp.asarray``/``jax.device_put`` may only take
+
+- literal constants (scalars, tuples/lists of literals) — nothing to
+  alias, and inside traced code ``jnp.asarray(0, ...)`` must stay
+  ``asarray`` (a copy op on a tracer would change the program), or
+- values that are already jax arrays (a direct ``jnp.*``/``jax.*`` call).
+
+Everything else — names, attributes, subscripts, ``np.asarray(...)``
+pass-throughs — must go through the copying helper
+``ipex_llm_tpu.hostutil.h2d`` (or carry a suppression explaining why the
+buffer provably outlives the dispatch unmutated).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.core import ERROR, register
+
+_UPLOADS = {"jax.numpy.asarray", "jax.device_put"}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+def _is_device_valued(node: ast.AST, aliases: dict[str, str]) -> bool:
+    """Already a jax value: a direct jnp.* / jax.* call result."""
+    if isinstance(node, ast.Call):
+        tgt = astutil.call_target(node, aliases)
+        return bool(tgt and tgt.startswith("jax."))
+    return False
+
+
+@register("JL001", "aliasing-upload", ERROR,
+          "zero-copy upload of a possibly-mutable host buffer in an "
+          "async-dispatch module; use hostutil.h2d")
+def check(ctx, config):
+    if not config.in_async(ctx.key):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        tgt = astutil.call_target(node, ctx.aliases)
+        if tgt not in _UPLOADS:
+            continue
+        arg = node.args[0]
+        if _is_literal(arg) or _is_device_valued(arg, ctx.aliases):
+            continue
+        # already routed through a blessed copying helper (h2d(x) is a
+        # fresh device array; re-wrapping it is pointless but not a race)
+        if isinstance(arg, ast.Call):
+            an = astutil.dotted_name(arg.func)
+            if an in config.upload_helpers:
+                continue
+        fn = tgt.rsplit(".", 1)[-1]
+        yield ctx.finding(
+            "JL001", ERROR, node,
+            f"{fn}() on a possibly-mutable host buffer in an async-dispatch "
+            f"module zero-copy-aliases live memory (alignment-dependent "
+            f"stream corruption); upload via hostutil.h2d (copying)")

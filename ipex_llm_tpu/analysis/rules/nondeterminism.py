@@ -1,0 +1,71 @@
+"""JL005 nondeterminism-in-jit: trace-time entropy baked into programs.
+
+Anything evaluated inside traced code runs ONCE, at trace time, and its
+value is burned into the compiled program: ``time.time()`` becomes a
+constant timestamp, ``np.random.*``/``random.*`` draws one host sample
+shared by every subsequent step, and iterating a ``set`` bakes an
+arbitrary (hash-seed-dependent) pytree order into the jaxpr — the
+bit-identity contracts the serving equivalence suites enforce
+(test_serving_mixed/horizon) cannot survive any of these.
+
+Inside traced scopes this rule flags:
+
+- calls into ``time.*``, stdlib ``random.*``, ``np.random.*``,
+  ``datetime.*``, ``uuid.*``, ``secrets.*``, ``os.urandom`` — on-device
+  randomness must come from ``jax.random`` with an explicit key,
+- iteration over a ``set`` literal / ``set(...)`` call (arbitrary order
+  changes pytree structure between processes).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.core import ERROR, register
+
+_BANNED_PREFIXES = ("time.", "random.", "numpy.random.", "datetime.",
+                    "uuid.", "secrets.")
+_BANNED_EXACT = {"os.urandom"}
+
+
+def _banned(target: str | None) -> bool:
+    return bool(target) and (target in _BANNED_EXACT
+                             or target.startswith(_BANNED_PREFIXES))
+
+
+def _is_set_expr(node: ast.AST, aliases) -> bool:
+    if isinstance(node, ast.Set):
+        return True
+    if isinstance(node, ast.Call):
+        tgt = astutil.call_target(node, aliases)
+        return tgt in {"set", "frozenset"}
+    return False
+
+
+@register("JL005", "nondeterminism-in-jit", ERROR,
+          "wall-clock / host RNG / set-iteration inside traced code is "
+          "evaluated once at trace time and baked into the program")
+def check(ctx, config):
+    for scope in astutil.traced_scopes(ctx.tree, ctx.aliases):
+        where = f"traced code ({scope.reason}, '{scope.name}')"
+        walk_root = scope.node.body if isinstance(scope.node, ast.Lambda) \
+            else scope.node
+        for node in ast.walk(walk_root):
+            if isinstance(node, ast.Call):
+                tgt = astutil.call_target(node, ctx.aliases)
+                if _banned(tgt):
+                    yield ctx.finding(
+                        "JL005", ERROR, node,
+                        f"{tgt}() inside {where} evaluates once at trace "
+                        "time and is baked into the compiled program — use "
+                        "jax.random with an explicit key / pass host values "
+                        "as arguments")
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if _is_set_expr(it, ctx.aliases):
+                    yield ctx.finding(
+                        "JL005", ERROR, it,
+                        f"iterating a set inside {where} bakes an arbitrary "
+                        "hash order into the traced program — sort it or "
+                        "use a tuple/list")

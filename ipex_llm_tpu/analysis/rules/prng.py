@@ -1,0 +1,209 @@
+"""JL006 prng-key-reuse: one key, two draws, correlated "randomness".
+
+``jax.random`` keys are pure values: feeding the SAME key to two sampler
+calls yields two *identical* (or correlated) draws — temperature
+sampling that silently repeats tokens, speculative accept tests that
+correlate with the draft's proposals.  The engine's seeded-stream
+contract (fold_in(seed, output_index), split-per-step chain) exists
+precisely so every draw has a fresh key.
+
+Per function scope, straight-line dataflow over key-typed names:
+
+- a name becomes FRESH when assigned from ``PRNGKey``/``split``/
+  ``fold_in``/``clone`` (or any reassignment),
+- a sampler call (``categorical``/``uniform``/``normal``/...) CONSUMES
+  the key name it is passed; a second consumption without an intervening
+  reassignment is flagged,
+- ``split``/``fold_in`` take a key WITHOUT consuming it (deriving new
+  keys is the blessed way to reuse),
+- a sampler consuming a loop-invariant key inside a ``for``/``while``
+  body (key never reassigned in the body) is flagged — every iteration
+  would draw the same sample.
+
+Only bare names and ``self.*`` attributes are tracked; aggregate/indexed
+keys (``keys[i]``) are out of scope for the heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.core import ERROR, register
+
+_CONSUMERS = {
+    "categorical", "uniform", "normal", "bernoulli", "gumbel", "exponential",
+    "laplace", "logistic", "randint", "truncated_normal", "choice",
+    "permutation", "shuffle", "bits", "poisson", "gamma", "beta", "dirichlet",
+    "multivariate_normal", "rademacher", "cauchy", "maxwell", "orthogonal",
+    "t", "ball", "loggamma", "binomial", "geometric",
+}
+_DERIVERS = {"split", "fold_in", "clone", "wrap_key_data", "key", "PRNGKey",
+             "key_data"}
+
+
+def _key_token(node: ast.AST) -> str | None:
+    """Trackable key expression -> stable token ('key', 'self.key')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _random_call_kind(call: ast.Call, aliases) -> tuple[str, str] | None:
+    """("consume"|"derive", short_name) for jax.random.* calls."""
+    tgt = astutil.call_target(call, aliases)
+    if not tgt or not tgt.startswith("jax.random."):
+        return None
+    short = tgt.rsplit(".", 1)[-1]
+    if short in _CONSUMERS:
+        return ("consume", short)
+    if short in _DERIVERS:
+        return ("derive", short)
+    return None
+
+
+def _key_arg(call: ast.Call) -> ast.AST | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+def _assigned_tokens(node: ast.AST) -> set[str]:
+    """Tokens (re)bound anywhere under ``node``."""
+    out: set[str] = set()
+    for sub in ast.walk(node):
+        targets = []
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+            targets = [sub.target]
+        elif isinstance(sub, ast.For):
+            targets = [sub.target]
+        for t in targets:
+            stack = [t]
+            while stack:
+                e = stack.pop()
+                if isinstance(e, (ast.Tuple, ast.List)):
+                    stack.extend(e.elts)
+                else:
+                    tok = _key_token(e)
+                    if tok:
+                        out.add(tok)
+    return out
+
+
+class _Scope:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.used: dict[str, str] = {}   # token -> sampler that consumed it
+
+    def clear(self, tok: str) -> None:
+        self.used.pop(tok, None)
+
+    def fork(self) -> "_Scope":
+        child = _Scope(self.ctx)
+        child.used = dict(self.used)
+        return child
+
+
+@register("JL006", "prng-key-reuse", ERROR,
+          "a jax.random key consumed by two draws without an intervening "
+          "split/fold_in — correlated samples")
+def check(ctx, config):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+        scope = _Scope(ctx)
+        body = fn.body if not isinstance(fn, ast.Lambda) \
+            else [ast.Expr(fn.body)]
+        yield from _visit(ctx, scope, body, loop_reassigned=None)
+
+
+def _visit(ctx, scope, stmts, loop_reassigned):
+    for st in stmts:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue          # separate scope
+        # expressions first (RHS evaluates before the binding lands)
+        for f in _expr_findings(ctx, scope, st, loop_reassigned):
+            yield f
+        # then clear anything this statement rebinds
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.For)):
+            for tok in _assigned_tokens(st):
+                scope.clear(tok)
+        if isinstance(st, (ast.For, ast.While)):
+            body_assigned = _assigned_tokens(st)
+            yield from _visit(ctx, scope, st.body, body_assigned)
+            yield from _visit(ctx, scope, st.orelse, loop_reassigned)
+        elif isinstance(st, ast.If):
+            # branches are mutually exclusive per execution (and often per
+            # PROGRAM — static python flags select one at trace time), so
+            # consumption in one branch must not taint the other; state
+            # after the if is the union of both arms
+            body_scope = scope.fork()
+            else_scope = scope.fork()
+            yield from _visit(ctx, body_scope, st.body, loop_reassigned)
+            yield from _visit(ctx, else_scope, st.orelse, loop_reassigned)
+            scope.used = {**body_scope.used, **else_scope.used}
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            yield from _visit(ctx, scope, st.body, loop_reassigned)
+        elif isinstance(st, ast.Try):
+            yield from _visit(ctx, scope, st.body, loop_reassigned)
+            for h in st.handlers:
+                yield from _visit(ctx, scope, h.body, loop_reassigned)
+            yield from _visit(ctx, scope, st.orelse, loop_reassigned)
+            yield from _visit(ctx, scope, st.finalbody, loop_reassigned)
+
+
+def _walk_no_lambda(node):
+    """ast.walk that does not descend into nested lambdas/defs (they are
+    their own key scopes)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.Lambda, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                stack.append(child)
+
+
+def _expr_findings(ctx, scope, st, loop_reassigned):
+    # don't descend into nested statements (handled by _visit) or defs
+    exprs = []
+    for child in ast.iter_child_nodes(st):
+        if isinstance(child, ast.expr):
+            exprs.append(child)
+    for expr in exprs:
+        for node in _walk_no_lambda(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _random_call_kind(node, ctx.aliases)
+            if not kind or kind[0] != "consume":
+                continue
+            key = _key_arg(node)
+            tok = _key_token(key) if key is not None else None
+            if tok is None:
+                continue
+            if tok in scope.used:
+                yield ctx.finding(
+                    "JL006", ERROR, node,
+                    f"key '{tok}' already consumed by jax.random."
+                    f"{scope.used[tok]}() — a second jax.random.{kind[1]}() "
+                    "draw with the same key is correlated; split/fold_in "
+                    "first")
+            elif loop_reassigned is not None and tok not in loop_reassigned:
+                yield ctx.finding(
+                    "JL006", ERROR, node,
+                    f"key '{tok}' is consumed by jax.random.{kind[1]}() "
+                    "inside a loop but never reassigned in the loop body — "
+                    "every iteration draws the same sample; split/fold_in "
+                    "per iteration")
+            else:
+                scope.used[tok] = kind[1]

@@ -1,0 +1,99 @@
+"""JL003 recompile-hazard: silent retraces that turn jit into a no-op.
+
+Two shapes this repo has been bitten by (see generation.py's bucketing
+and the pow2 chunk widths in the mixed step):
+
+- **fresh jit per call**: ``jax.jit(f)(x)`` or ``jax.jit(lambda ...)``
+  evaluated inside a function body builds a NEW wrapper every call —
+  jit's cache is keyed on the wrapper, so every invocation retraces
+  (and recompiles unless the persistent cache saves you).  Hoist to
+  module level or cache the wrapper.
+- **unbucketed dynamic dim**: a ``len(...)``- or ``.shape``-derived
+  value fed straight into a known-jitted callable compiles one program
+  per distinct value.  Dims must pass through a bucketing helper
+  (``_round_up`` / ``_bucket`` / ``pad_batch`` — config.bucket_helpers)
+  so the program count stays bounded.
+
+Heuristic tier (warn): the second shape can't see through call chains,
+so it only checks direct calls to names jit-bound in the same module,
+inside the configured hot modules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.core import WARN, register
+from ipex_llm_tpu.analysis.rules.hostsync import _jit_bound_names
+
+
+def _contains_dynamic_dim(node: ast.AST, aliases, bucket_helpers) -> bool:
+    """len()/.shape-derived value not routed through a bucket helper."""
+    dyn = False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in bucket_helpers:
+                return False          # bucketed somewhere in the expression
+            if isinstance(f, ast.Name) and f.id == "len":
+                dyn = True
+        elif isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            dyn = True
+    return dyn
+
+
+@register("JL003", "recompile-hazard", WARN,
+          "fresh jax.jit wrapper per call, or an unbucketed dynamic "
+          "dimension feeding a jitted function")
+def check(ctx, config):
+    # (a) fresh jit wrapper built inside a function body
+    for outer in ast.walk(ctx.tree):
+        if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(outer):
+            if not isinstance(node, ast.Call):
+                continue
+            # jax.jit(f)(args): the callee itself is a jit(...) call
+            if isinstance(node.func, ast.Call) and astutil.is_jit_expr(
+                    node.func, ctx.aliases):
+                yield ctx.finding(
+                    "JL003", WARN, node,
+                    "jax.jit(...)(...) builds and discards a fresh jit "
+                    "wrapper every call — every invocation retraces; hoist "
+                    "the wrapper to module level or cache it")
+            # jax.jit(lambda ...) evaluated per call
+            elif astutil.is_jit_expr(node, ctx.aliases) and node.args and \
+                    isinstance(node.args[0], ast.Lambda):
+                yield ctx.finding(
+                    "JL003", WARN, node,
+                    "jax.jit of a lambda inside a function body makes a new "
+                    "wrapper (new cache key) per call — name the function "
+                    "and jit it once")
+
+    # (b) unbucketed dynamic dims into same-module jitted callables
+    if not config.in_hot(ctx.key):
+        return
+    jit_names = _jit_bound_names(ctx.tree, ctx.aliases)
+    if not jit_names:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        if name not in jit_names:
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if _contains_dynamic_dim(arg, ctx.aliases, config.bucket_helpers):
+                yield ctx.finding(
+                    "JL003", WARN, arg,
+                    f"dynamic dimension ({ast.unparse(arg)}) feeds jitted "
+                    f"'{name}' without a bucketing helper — one compiled "
+                    "program per distinct value; wrap in "
+                    "_round_up/_bucket/pad_batch")

@@ -1,0 +1,140 @@
+"""JL004 tracer-leak: side effects escaping traced code.
+
+A function running under ``jax.jit`` / ``lax.while_loop`` / ``lax.scan``
+executes its Python body ONCE, at trace time.  Anything it writes to
+``self``, a global, a closed-over list — happens once with abstract
+tracers (or stale trace-time values), not per step: state silently
+freezes, or a tracer leaks out and explodes later with the infamous
+"leaked tracer" error far from the cause.
+
+Flagged inside traced scopes (jit-decorated functions, bodies passed to
+lax control flow, vmapped/grad'd functions, and everything nested in
+them):
+
+- assignment to ``self.*`` (or any attribute of a non-local object),
+- ``global`` / ``nonlocal`` declarations,
+- subscript stores to non-local names (``table[i] = ...``),
+- mutating method calls (``.append``/``.extend``/``.add``/``.update``/
+  ``.pop``/``.setdefault``) on non-local names.
+
+Locals are fine — a list built and consumed within one trace is just
+staging (the unrolled-loop idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.core import ERROR, register
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "setdefault", "popitem", "remove", "discard", "clear"}
+
+
+def _local_names(fn) -> set[str]:
+    out: set[str] = set()
+    a = fn.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        out.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    out.update(e.id for e in t.elts
+                               if isinstance(e, ast.Name))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+        elif isinstance(node, ast.comprehension):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    out.add(item.optional_vars.id)
+    return out
+
+
+def _root_name(node: ast.AST) -> str | None:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _scope_body(scope) -> list[ast.AST]:
+    """Statements of the scope, not descending into nested function defs
+    (those are their own TracedScope entries)."""
+    nodes: list[ast.AST] = []
+    body = scope.node.body if not isinstance(scope.node, ast.Lambda) \
+        else [ast.Expr(scope.node.body)]
+
+    def rec(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            nodes.append(st)
+            for field_ in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field_, None)
+                if isinstance(sub, list):
+                    rec([s for s in sub if isinstance(s, ast.stmt)])
+            for h in getattr(st, "handlers", []):
+                rec(h.body)
+    rec([s for s in body if isinstance(s, ast.stmt)])
+    return nodes
+
+
+@register("JL004", "tracer-leak", ERROR,
+          "side effect (self/global/closure mutation) inside jit- or "
+          "lax-traced code runs once at trace time, not per step")
+def check(ctx, config):
+    for scope in astutil.traced_scopes(ctx.tree, ctx.aliases):
+        locals_ = _local_names(scope.node)
+        where = f"traced code ({scope.reason}, '{scope.name}')"
+        for st in _scope_body(scope):
+            if isinstance(st, (ast.Global, ast.Nonlocal)):
+                kw = "global" if isinstance(st, ast.Global) else "nonlocal"
+                yield ctx.finding(
+                    "JL004", ERROR, st,
+                    f"'{kw} {', '.join(st.names)}' inside {where} — writes "
+                    "land at trace time, not per executed step")
+                continue
+            targets = []
+            if isinstance(st, ast.Assign):
+                targets = st.targets
+            elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+                targets = [st.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    root = _root_name(t)
+                    if root == "self" or (root and root not in locals_):
+                        yield ctx.finding(
+                            "JL004", ERROR, t,
+                            f"assignment to {ast.unparse(t)} inside {where} "
+                            "— attribute writes escape the trace (state "
+                            "freezes / tracer leak); return the value "
+                            "through the carry instead")
+                elif isinstance(t, ast.Subscript):
+                    root = _root_name(t)
+                    if root and root not in locals_:
+                        yield ctx.finding(
+                            "JL004", ERROR, t,
+                            f"subscript store to non-local '{root}' inside "
+                            f"{where} — use functional .at[].set() on a "
+                            "carried array")
+            if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                f = st.value.func
+                if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                    root = _root_name(f.value)
+                    if root and root not in locals_ and root != "self":
+                        yield ctx.finding(
+                            "JL004", ERROR, st.value,
+                            f"'{root}.{f.attr}(...)' mutates a non-local "
+                            f"inside {where} — happens once at trace time")

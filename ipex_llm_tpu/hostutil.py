@@ -1,0 +1,54 @@
+"""Host<->device transfer helpers shared by every async-dispatch module.
+
+The one rule this module exists to enforce (see PR 2's stream-corruption
+race, fixed in ``serving/engine.py``, and rule JL001 in
+``ipex_llm_tpu.analysis``):
+
+    A MUTABLE host buffer must never be uploaded with zero-copy
+    semantics while dispatch is asynchronous.
+
+``jnp.asarray`` on the CPU backend zero-copy-aliases suitably-aligned
+numpy buffers, and dispatch is async — a program still in flight reads
+the live buffer AFTER host-side bookkeeping mutates it (the serving
+engine's row_lens/temps/tables advance every tick; a generate() caller
+may recycle its prompt buffer).  Whether a given array aliases depends
+on where numpy's allocator placed it, so the corruption is alignment-
+and history-dependent: the worst kind of intermittent.  ``jnp.array``
+(copy semantics) pins a snapshot the device owns.
+
+Use :func:`h2d` at every host->device boundary whose source is (or may
+be) a mutable numpy buffer.  Literal constants and values that are
+already jax arrays may keep ``jnp.asarray``; ``ipex_llm_tpu.analysis``
+rule JL001 machine-checks exactly that contract over the async-dispatch
+modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def h2d(x: Any, dtype: Any = None) -> jnp.ndarray:
+    """Upload host data to the device, ALWAYS copying.
+
+    Drop-in for ``jnp.asarray`` at mutable-buffer boundaries: same
+    signature shape (value, optional dtype), but guaranteed copy
+    semantics, so the caller may mutate or free ``x`` immediately after
+    the call even while async dispatch is still reading the upload.
+    """
+    return jnp.array(x, dtype=dtype)
+
+
+def d2h(x: Any) -> np.ndarray:
+    """Materialise a device value on the host (np.asarray; BLOCKING sync).
+
+    Exists so hot-path code can name its designed sync points — rule
+    JL002 flags ad-hoc ``np.asarray``/``int()``/``.item()`` syncs in the
+    engine tick/decode paths; routing a *designed* sync through ``d2h``
+    (with a JL002 suppression and reason at the call site) keeps the
+    inventory of blocking points auditable.
+    """
+    return np.asarray(x)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ipex_llm_tpu.hostutil import h2d
 from ipex_llm_tpu.models.config import ModelConfig
 
 EXPERT_SLOTS = ("moe_gate_up", "moe_down")
@@ -76,6 +77,7 @@ class ExpertStore:
         entry = {}
         for slot, stacked in self.host.items():
             per = jax.tree_util.tree_map(lambda a: a[layer, expert], stacked)
+            # jaxlint: disable=JL001 -- zero-copy is intended here: host expert stacks are written once at split time and never mutated; copying would double peak host RAM per expert fetch
             entry[slot] = jax.device_put(per)   # async dispatch
         size = sum(_qt_nbytes(v) for v in entry.values())
         while self._used + size > self.budget and self._cache:
@@ -114,7 +116,7 @@ def _embed(cfg: ModelConfig, params, tokens):
 
     x = embed_lookup(params["embed"], tokens, COMPUTE_DTYPE)
     if cfg.embedding_multiplier != 1.0:
-        x = x * jnp.asarray(cfg.embedding_multiplier, COMPUTE_DTYPE)
+        x = x * h2d(cfg.embedding_multiplier, COMPUTE_DTYPE)
     return x
 
 
@@ -135,7 +137,7 @@ def _layer_attn_router(cfg: ModelConfig, layer, params, x, kl, vl,
         sliding, cache, 0,
     )
     if cfg.residual_multiplier != 1.0:  # minicpm-style depth scaling
-        attn_out = attn_out * jnp.asarray(cfg.residual_multiplier,
+        attn_out = attn_out * h2d(cfg.residual_multiplier,
                                           attn_out.dtype)
     x = x + attn_out
     h = dec._norm(x, lp["mlp_norm"], cfg)
@@ -185,7 +187,7 @@ def _apply_experts(cfg: ModelConfig, n_exp: int, layer, params, x, h,
             ys = ys * g.astype(ys.dtype)
         y = y + ys
     if cfg.residual_multiplier != 1.0:  # minicpm-style depth scaling
-        y = y * jnp.asarray(cfg.residual_multiplier, y.dtype)
+        y = y * h2d(cfg.residual_multiplier, y.dtype)
     return x + y
 
 
@@ -238,7 +240,7 @@ class OffloadedMoE:
         cfg = self.cfg
         b, t = tokens.shape
         x = _embed(cfg, self.params, tokens)
-        slot0_j = jnp.asarray(slot0, jnp.int32)
+        slot0_j = h2d(slot0, jnp.int32)
         q_slots = jnp.broadcast_to(
             slot0_j + jnp.arange(t)[None, :], (b, t)
         )
@@ -254,9 +256,9 @@ class OffloadedMoE:
         for layer in range(cfg.num_layers):
             kl, vl = caches[layer]
             x, h, w, idx, kl, vl = _layer_attn_router(
-                cfg, jnp.asarray(layer, jnp.int32), self.params, x, kl, vl,
+                cfg, h2d(layer, jnp.int32), self.params, x, kl, vl,
                 slot0_j, q_slots, kv_len, kv_start, cos, sin,
-                jnp.asarray(cfg.layer_is_sliding(layer)), proto,
+                h2d(cfg.layer_is_sliding(layer)), proto,
             )
             caches[layer] = (kl, vl)
             # host sync: which experts does this layer need?
@@ -277,8 +279,8 @@ class OffloadedMoE:
                 entry = self.store.get(layer, e)
                 qts.append((entry["moe_gate_up"], entry["moe_down"]))
             x = _apply_experts(
-                cfg, n_exp, jnp.asarray(layer, jnp.int32), self.params, x, h,
-                jnp.asarray(gates), tuple(qts),
+                cfg, n_exp, h2d(layer, jnp.int32), self.params, x, h,
+                h2d(gates), tuple(qts),
             )
         return _final_logits(cfg, self.params, x), caches
 
@@ -303,10 +305,10 @@ class OffloadedMoE:
 
         proto = _replace(full, k=full.k[:1, :, :, :1], v=full.v[:1, :, :, :1])
 
-        logits, caches = self._forward(jnp.asarray(prompt), caches, proto, 0)
+        logits, caches = self._forward(h2d(prompt), caches, proto, 0)
         out = [int(np.asarray(jnp.argmax(logits, -1))[0])]
         for step in range(1, max_new_tokens):
-            tok = jnp.asarray([[out[-1]]], jnp.int32)
+            tok = h2d([[out[-1]]], jnp.int32)
             logits, caches = self._forward(tok, caches, proto,
                                            t0 + step - 1)
             out.append(int(np.asarray(jnp.argmax(logits, -1))[0]))

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ipex_llm_tpu.hostutil import d2h, h2d
 from ipex_llm_tpu.kv import PagedKVCache
 from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
@@ -52,19 +53,12 @@ NEG_INF = -1e30
 # from "engine-level fault", which is None)
 _FAULT_VANISHED = object()
 
-
-def _h2d(x: np.ndarray) -> jnp.ndarray:
-    """Upload a MUTABLE engine-state array, always copying.
-
-    ``jnp.asarray`` on the CPU backend zero-copy-aliases suitably-aligned
-    numpy buffers, and dispatch is async — a program still in flight reads
-    the live buffer AFTER the engine's host-side bookkeeping mutates it
-    (row_lens/temps/tables advance every tick).  Whether a given array
-    aliases depends on where numpy's allocator placed it, so the
-    corruption is alignment- and history-dependent.  ``jnp.array`` (copy
-    semantics) pins a snapshot the device owns.  Fresh per-call arrays
-    that are never mutated may still use ``jnp.asarray``."""
-    return jnp.array(x)
+# The copying upload helper was born here (PR 2's stream-corruption fix:
+# jnp.asarray zero-copy-aliases mutable numpy buffers while async dispatch
+# is still reading them) and is now shared by every async-dispatch module
+# from ipex_llm_tpu.hostutil; jaxlint rule JL001 enforces its use.  The
+# old private name stays importable for compatibility.
+_h2d = h2d
 
 
 @dataclass(frozen=True)
@@ -296,7 +290,7 @@ def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
         # output stays bit-identical.
         write_at = jnp.where(
             alive, row_lens,
-            jnp.asarray(cache.tables.shape[1] * cache.page_size,
+            h2d(cache.tables.shape[1] * cache.page_size,
                         jnp.int32))
         logits, cache = decoder_forward(
             cfg, params, toks[:, None], cache, row_lens[:, None],
@@ -1129,16 +1123,16 @@ class ServingEngine:
                 ids = list(r.eos_token_id)
                 eos[i, :len(ids)] = ids
         self._dev = {
-            "toks": _h2d(self.toks),
-            "row_lens": _h2d(self.row_lens),
-            "active": jnp.asarray(active),
-            "temps": _h2d(self.temps),
-            "top_ps": _h2d(self.top_ps),
-            "seeds": _h2d(self.seeds),
-            "top_ks": _h2d(self.top_ks),
-            "steps": jnp.asarray(steps),
-            "remain": jnp.asarray(remain),
-            "eos": jnp.asarray(eos),
+            "toks": h2d(self.toks),
+            "row_lens": h2d(self.row_lens),
+            "active": h2d(active),
+            "temps": h2d(self.temps),
+            "top_ps": h2d(self.top_ps),
+            "seeds": h2d(self.seeds),
+            "top_ks": h2d(self.top_ks),
+            "steps": h2d(steps),
+            "remain": h2d(remain),
+            "eos": h2d(eos),
         }
         # tables ride the dirty-row scatter even on full epochs: every
         # mixed tick is an epoch (row_lens advance), and re-uploading the
@@ -1154,7 +1148,7 @@ class ServingEngine:
         if self._dirty_tables:
             rows = np.array(sorted(self._dirty_tables), np.int32)
             self.cache = self.cache.with_table_rows(
-                jnp.asarray(rows), jnp.asarray(self.tables[rows]))
+                h2d(rows), h2d(self.tables[rows]))
             self.metrics["table_row_syncs"] += 1
             self._dirty_tables.clear()
         return self.cache
@@ -1312,9 +1306,9 @@ class ServingEngine:
         # not the whole [R, maxP] table per chunk
         cache = self._flush_dirty_tables()
         logits, self.cache = _prefill_chunk(
-            self.cfg, self.params, cache, jnp.asarray(toks),
-            _h2d(self.tables[row : row + 1]),
-            jnp.asarray(base, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+            self.cfg, self.params, cache, h2d(toks),
+            h2d(self.tables[row : row + 1]),
+            h2d(base, jnp.int32), h2d(n_valid, jnp.int32),
             mesh=self.mesh,
         )
         self.row_lens[row] = base + n_valid
@@ -1328,17 +1322,18 @@ class ServingEngine:
 
         self.key, sub = jax.random.split(self.key)
         first_t, first_lp = sample_rows_with_logprobs(
-            logits, jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_p], jnp.float32), sub,
-            seeds=jnp.asarray([-1 if req.seed is None else int(req.seed)],
+            logits, h2d([req.temperature], jnp.float32),
+            h2d([req.top_p], jnp.float32), sub,
+            seeds=h2d([-1 if req.seed is None else int(req.seed)],
                               jnp.int32),
             steps=jnp.zeros((1,), jnp.int32),
-            top_ks=jnp.asarray([max(0, int(req.top_k or 0))], jnp.int32),
+            top_ks=h2d([max(0, int(req.top_k or 0))], jnp.int32),
         )
         self._fault_point("sample", rows=(row,))
         t0 = time.perf_counter()
-        first = int(np.asarray(first_t)[0])
-        first_lp = np.asarray(first_lp)
+        # jaxlint: disable=JL002 -- designed sync: the first token must reach the host to emit (TTFT); counted via _count_sync
+        first = int(d2h(first_t)[0])
+        first_lp = d2h(first_lp)  # jaxlint: disable=JL002 -- same designed first-token sync; already blocked on first_t above
         self._count_sync(time.perf_counter() - t0)  # blocking materialization
         self._finish_prompt(row, first, float(first_lp[0]))
 
@@ -1475,14 +1470,15 @@ class ServingEngine:
             extra = {"n_micro": self.mesh.shape["pp"]}
         t_all, lp_all, self.cache, self.key = verify_fn(
             self.cfg, self.params, cache,
-            _h2d(self.toks), jnp.asarray(drafts),
-            _h2d(self.row_lens), jnp.asarray(active),
-            _h2d(self.temps), _h2d(self.top_ps), self.key,
-            _h2d(self.seeds), jnp.asarray(steps),
-            _h2d(self.top_ks), k=k, mesh=self.mesh, **extra,
+            h2d(self.toks), h2d(drafts),
+            h2d(self.row_lens), h2d(active),
+            h2d(self.temps), h2d(self.top_ps), self.key,
+            h2d(self.seeds), h2d(steps),
+            h2d(self.top_ks), k=k, mesh=self.mesh, **extra,
         )
         t0 = time.perf_counter()
-        t_all, lp_all = np.asarray(t_all), np.asarray(lp_all)
+        # jaxlint: disable=JL002 -- designed sync: the verify round's accepted tokens must reach the host to walk acceptance chains; counted via _count_sync
+        t_all, lp_all = d2h(t_all), d2h(lp_all)
         self._count_sync(time.perf_counter() - t0)
         self.metrics["steps"] += 1
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
@@ -1672,12 +1668,12 @@ class ServingEngine:
             else:
                 maxp_b = self.ec.max_pages
             sliced = cache.with_tables(
-                full_tables[jnp.asarray(row_idx)][:, :maxp_b])
+                full_tables[h2d(row_idx)][:, :maxp_b])
             nxt, lp, out, self.key = _mixed_prefill_fn(
-                self.cfg, self.params, sliced, jnp.asarray(toks),
-                jnp.asarray(base), jnp.asarray(n_valid), jnp.asarray(emit),
-                jnp.asarray(temps), jnp.asarray(top_ps), self.key,
-                jnp.asarray(seeds), jnp.asarray(top_ks), mesh=self.mesh)
+                self.cfg, self.params, sliced, h2d(toks),
+                h2d(base), h2d(n_valid), h2d(emit),
+                h2d(temps), h2d(top_ps), self.key,
+                h2d(seeds), h2d(top_ks), mesh=self.mesh)
             self.cache = out.with_tables(full_tables)
             # advance bookkeeping; completed prompts run the shared
             # completion path (_finish_prompt) once their token arrives
@@ -1706,7 +1702,8 @@ class ServingEngine:
                 self._fault_point("sample",
                                   rows=[row for _, row in completing])
                 t0 = time.perf_counter()
-                nxt, lp = np.asarray(nxt), np.asarray(lp)
+                # jaxlint: disable=JL002 -- designed sync: first tokens of prompts completing this mixed tick must reach the host to emit; counted via _count_sync
+                nxt, lp = d2h(nxt), d2h(lp)
                 self._count_sync(time.perf_counter() - t0)
                 for i, row in completing:
                     self._finish_prompt(row, int(nxt[i]), float(lp[i]))
@@ -1771,7 +1768,7 @@ class ServingEngine:
                 self.cfg, self.params, self.cache, dev["toks"],
                 dev["row_lens"], dev["active"], dev["temps"], dev["top_ps"],
                 self.key, dev["seeds"], dev["steps"], dev["top_ks"],
-                mesh=self.mesh, n_micro=self.mesh.shape["pp"])
+                mesh=self.mesh, n_micro=self.mesh.shape["pp"])  # jaxlint: disable=JL003 -- pp mesh shape is fixed for the engine lifetime: exactly one compiled program
             tok_block, lp_block = nxt[:, None], lp[:, None]
             # the pp schedule stays H=1 for now (a horizon scan would nest
             # the GPipe fill/drain per step); it still routes through this
@@ -1789,10 +1786,11 @@ class ServingEngine:
                 horizon=h, mesh=self.mesh)
             # the returned cache owns the (donated) tables buffer now
         t0 = time.perf_counter()
-        tok_block = np.asarray(tok_block)   # THE sync point: h tokens/sync
-        lp_block = np.asarray(lp_block)
+        tok_block = d2h(tok_block)   # jaxlint: disable=JL002 -- THE per-horizon designed sync: h tokens per host round trip, counted via _count_sync
+        lp_block = d2h(lp_block)  # jaxlint: disable=JL002 -- rides THE per-horizon sync above (same dispatched program)
         if not self._pp_mode:
-            executed = int(np.asarray(n_exec))  # < h if every row died early
+            # jaxlint: disable=JL002 -- rides THE per-horizon sync: < h only if every row died early
+            executed = int(d2h(n_exec))
         self._count_sync(time.perf_counter() - t0)
         self.metrics["steps"] += executed
         self.metrics["decode_horizon_effective"] = h

@@ -46,6 +46,7 @@ from ipex_llm_tpu.generation import (
     _round_up,
     pad_batch,
 )
+from ipex_llm_tpu.hostutil import d2h, h2d
 from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
 
@@ -106,7 +107,7 @@ def _spec_loop(
     auto_th_stop_draft) — so low-confidence rounds don't burn k draft
     forwards.  All shapes stay static; only trip counts vary.
     """
-    eos = jnp.asarray(eos_ids, jnp.int32) if eos_ids else None
+    eos = h2d(eos_ids, jnp.int32) if eos_ids else None
     s_max = seq_buf.shape[1]
     vocab = cfg.vocab_size
     sampling = sp is not None and sp.do_sample
@@ -189,7 +190,7 @@ def _spec_loop(
         # lookup proposals carry no distribution: verification falls back to
         # prefix-matching against per-position target samples (still exact)
         qbuf = jnp.zeros((k, vocab), jnp.float32)
-        return drafts, qbuf, jnp.asarray(k, jnp.int32), draft_cache, key
+        return drafts, qbuf, h2d(k, jnp.int32), draft_cache, key
 
     lookup_mode = draft_params is None
     candidates = lookup_candidates if lookup_mode else draft_model_candidates
@@ -396,7 +397,7 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
 
     seq_buf = np.zeros((1, s_max), np.int32)
     seq_buf[0, :n_p] = tokens[0, tpad - n_p:]
-    seq_buf = jnp.asarray(seq_buf)
+    seq_buf = h2d(seq_buf)
 
     # prefill both models; sample the first token from the target
     t0 = time.perf_counter()
@@ -431,20 +432,20 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
     else:
         first = _greedy(logits)
     seq_buf = jax.lax.dynamic_update_slice(seq_buf, first[None], (0, n_p))
-    jax.block_until_ready(first)
+    jax.block_until_ready(first)  # jaxlint: disable=JL002 -- deliberate: TTFT measurement needs the first token finished before the clock stops
     ttft = time.perf_counter() - t0
 
     t1 = time.perf_counter()
     seq_buf, n_new, rounds, drafted, matched, th_final = _spec_loop(
         cfg, draft_cfg, params,
         None if lookup else draft_params,
-        cache, draft_cache, seq_buf, jnp.asarray(n_p, jnp.int32),
-        key, jnp.asarray(th_stop_draft, jnp.float32),
+        cache, draft_cache, seq_buf, h2d(n_p, jnp.int32),
+        key, h2d(th_stop_draft, jnp.float32),
         k, gen.max_new_tokens, gen.eos_token_id, ngram=ngram_size,
         sp=sp, adaptive=auto_th_stop_draft,
     )
-    seq = np.asarray(seq_buf)
-    n_new = int(n_new)
+    seq = d2h(seq_buf)  # jaxlint: disable=JL002 -- end-of-generation materialization: the spec loop is done, the result must come home
+    n_new = int(n_new)  # jaxlint: disable=JL002 -- rides the end-of-generation sync above
     dt = time.perf_counter() - t1
 
     res = GenerateResult(
@@ -455,8 +456,8 @@ def _speculative_inner(cfg, params, input_ids, gen, draft_params, draft_cfg,
         rest_token_s=dt / max(n_new - 1, 1),
     )
     # reference-style acceptance telemetry (speculative.py clear_benchmarks)
-    res.n_rounds = int(rounds)
-    res.n_drafted = int(drafted)
-    res.n_matched = int(matched)
-    res.th_stop_draft = float(th_final)
+    res.n_rounds = int(rounds)  # jaxlint: disable=JL002 -- post-loop telemetry materialization, not in the decode loop
+    res.n_drafted = int(drafted)  # jaxlint: disable=JL002 -- post-loop telemetry materialization, not in the decode loop
+    res.n_matched = int(matched)  # jaxlint: disable=JL002 -- post-loop telemetry materialization, not in the decode loop
+    res.th_stop_draft = float(th_final)  # jaxlint: disable=JL002 -- post-loop telemetry materialization, not in the decode loop
     return res

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ipex_llm_tpu.hostutil import h2d
 from ipex_llm_tpu.models.build import build_params
 from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.families import WeightScheme, _base_cfg
@@ -188,15 +189,15 @@ class TPUModelForVision2Seq:
                           pixel_values, image_grid_thw):
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
-        toks = jnp.asarray(np.asarray(input_ids, np.int32)[None])
+        toks = h2d(np.asarray(input_ids, np.int32)[None])
         x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
         if pixel_values is not None:
             img_embeds = []
             off = 0
-            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            px = h2d(pixel_values, jnp.float32)
             for thw in image_grid_thw:
                 n = int(np.prod(thw))
-                freqs = jnp.asarray(vision_rotary(self.vision_config,
+                freqs = h2d(vision_rotary(self.vision_config,
                                                   tuple(thw)))
                 img_embeds.append(vision_forward(
                     self.vision_config, self.vision_params,
@@ -209,7 +210,7 @@ class TPUModelForVision2Seq:
             assert len(idx) == img.shape[0], (
                 f"{len(idx)} image tokens vs {img.shape[0]} image embeds"
             )
-            x = x.at[0, jnp.asarray(idx)].set(img)
+            x = x.at[0, h2d(idx)].set(img)
         return x
 
     def forward_logits(self, input_ids, pixel_values=None,
@@ -227,8 +228,8 @@ class TPUModelForVision2Seq:
             v_head_dim=self.config.v_dim,
         )
         logits, _ = decoder_forward(
-            self.config, self.params, jnp.asarray(ids[None]), cache,
-            jnp.asarray(pos[None]), input_embeds=x,
+            self.config, self.params, h2d(ids[None]), cache,
+            h2d(pos[None]), input_embeds=x,
         )
         return logits
 
@@ -244,7 +245,7 @@ class TPUModelForVision2Seq:
         # text continuation: all three channels advance together from the
         # multimodal position max (rope_delta), not the slot index
         return _greedy_generate(
-            self, ids, x, jnp.asarray(pos[None]),
+            self, ids, x, h2d(pos[None]),
             lambda step: jnp.full((1, 3, 1), n_p + step + delta, jnp.int32),
             max_new_tokens,
         )
@@ -275,7 +276,7 @@ def _greedy_generate(model, ids, embeds, prefill_pos, step_pos,
         v_head_dim=model.config.v_dim,
     )
     logits, cache = _mm_prefill(
-        model.config, model.params, cache, jnp.asarray(ids[None]),
+        model.config, model.params, cache, h2d(ids[None]),
         prefill_pos, embeds,
     )
     out = list(ids)
@@ -287,7 +288,7 @@ def _greedy_generate(model, ids, embeds, prefill_pos, step_pos,
             break
         logits, cache = _mm_decode(
             model.config, model.params, cache,
-            jnp.asarray([[tok]], jnp.int32), step_pos(step),
+            h2d([[tok]], jnp.int32), step_pos(step),
         )
         tok = int(jnp.argmax(logits[0, -1]))
     return np.asarray(out, np.int32)[None]
@@ -342,10 +343,10 @@ class TPUInternVLForConditionalGeneration:
         from ipex_llm_tpu.models.vision_internvl import internvl_vision_forward
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
-        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        toks = h2d(np.asarray(ids, np.int32)[None])
         x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
         if pixel_values is not None:
-            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            px = h2d(pixel_values, jnp.float32)
             img = internvl_vision_forward(
                 self.vision_config, self.vision_params, px
             ).reshape(-1, x.shape[-1]).astype(x.dtype)
@@ -353,7 +354,7 @@ class TPUInternVLForConditionalGeneration:
             assert len(idx) == img.shape[0], (
                 f"{len(idx)} image tokens vs {img.shape[0]} image embeds"
             )
-            x = x.at[0, jnp.asarray(idx)].set(img)
+            x = x.at[0, h2d(idx)].set(img)
         return x
 
     def forward_logits(self, input_ids, pixel_values=None, image_bound=None,
@@ -371,7 +372,7 @@ class TPUInternVLForConditionalGeneration:
         )
         pos = jnp.arange(len(ids))[None, :]
         logits, _ = decoder_forward(
-            self.config, self.params, jnp.asarray(ids[None]), cache, pos,
+            self.config, self.params, h2d(ids[None]), cache, pos,
             input_embeds=x,
         )
         return logits
@@ -384,7 +385,7 @@ class TPUInternVLForConditionalGeneration:
         x = self._embed_multimodal(ids, pixel_values, **mm)
         return _greedy_generate(
             self, ids, x, jnp.arange(n_p)[None, :],
-            lambda step: jnp.asarray([[n_p + step]], jnp.int32),
+            lambda step: h2d([[n_p + step]], jnp.int32),
             max_new_tokens,
         )
 
@@ -459,10 +460,10 @@ class TPULlavaForConditionalGeneration(TPUInternVLForConditionalGeneration):
         from ipex_llm_tpu.models.vision_clip import clip_vision_forward
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
-        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        toks = h2d(np.asarray(ids, np.int32)[None])
         x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
         if pixel_values is not None:
-            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            px = h2d(pixel_values, jnp.float32)
             img = clip_vision_forward(
                 self.vision_config, self.vision_params, px
             ).reshape(-1, x.shape[-1]).astype(x.dtype)
@@ -470,7 +471,7 @@ class TPULlavaForConditionalGeneration(TPUInternVLForConditionalGeneration):
             assert len(idx) == img.shape[0], (
                 f"{len(idx)} image tokens vs {img.shape[0]} image embeds"
             )
-            x = x.at[0, jnp.asarray(idx)].set(img)
+            x = x.at[0, h2d(idx)].set(img)
         return x
 
     @classmethod
@@ -597,10 +598,10 @@ class TPUQwenVLForConditionalGeneration(TPUInternVLForConditionalGeneration):
         from ipex_llm_tpu.models.vision_qwenvl import qwenvl_vision_forward
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
-        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        toks = h2d(np.asarray(ids, np.int32)[None])
         x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
         if pixel_values is not None:
-            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            px = h2d(pixel_values, jnp.float32)
             if px.ndim == 3:
                 px = px[None]
             img = qwenvl_vision_forward(self.vision_config,
@@ -701,10 +702,10 @@ class TPUMiniCPMVForConditionalGeneration(TPUInternVLForConditionalGeneration):
         from ipex_llm_tpu.models.vision_clip import clip_vision_forward
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
-        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        toks = h2d(np.asarray(ids, np.int32)[None])
         x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
         if pixel_values is not None:
-            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            px = h2d(pixel_values, jnp.float32)
             if px.ndim == 3:
                 px = px[None]
             feats = clip_vision_forward(self.vision_config,
@@ -787,7 +788,7 @@ class TPUGemma3ForConditionalGeneration(TPUInternVLForConditionalGeneration):
             vcfg, reader.reader.get, reader.reader.has, qtype)
         mp = prefix.replace("vision_tower.vision_model.",
                             "multi_modal_projector.")
-        vparams["proj_norm"] = jnp.asarray(
+        vparams["proj_norm"] = h2d(
             reader.reader.get(mp + "mm_soft_emb_norm.weight"), jnp.float32)
         vparams["proj_w"] = quantize_weight(
             np.ascontiguousarray(
@@ -821,10 +822,10 @@ class TPUGemma3ForConditionalGeneration(TPUInternVLForConditionalGeneration):
         from ipex_llm_tpu.models.vision_clip import clip_vision_forward
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
-        toks = jnp.asarray(np.asarray(ids, np.int32)[None])
+        toks = h2d(np.asarray(ids, np.int32)[None])
         x = embed_lookup(self.params["embed"], toks, jnp.bfloat16)
         if pixel_values is not None:
-            px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            px = h2d(pixel_values, jnp.float32)
             if px.ndim == 3:
                 px = px[None]
             feats = clip_vision_forward(self.vision_config,
@@ -832,12 +833,12 @@ class TPUGemma3ForConditionalGeneration(TPUInternVLForConditionalGeneration):
             img = self._project(feats).reshape(-1, x.shape[-1])
             # decoder scales input_embeds by the gemma multiplier; HF
             # splices image features unscaled — pre-divide to compensate
-            img = img / jnp.asarray(self.config.embedding_multiplier,
+            img = img / h2d(self.config.embedding_multiplier,
                                     img.dtype)
             (idx,) = np.nonzero(np.asarray(ids) == self.image_token_id)
             assert len(idx) == img.shape[0], (
                 f"{len(idx)} image tokens vs {img.shape[0]} image embeds")
-            x = x.at[0, jnp.asarray(idx)].set(img.astype(x.dtype))
+            x = x.at[0, h2d(idx)].set(img.astype(x.dtype))
         return x
 
     @classmethod
@@ -895,11 +896,11 @@ class TPUQwen2_5OmniThinker:
         from ipex_llm_tpu.ops.embedding import embed_lookup
 
         ids = np.asarray(ids, np.int32).reshape(-1)
-        x = embed_lookup(self.params["embed"], jnp.asarray(ids[None]),
+        x = embed_lookup(self.params["embed"], h2d(ids[None]),
                          jnp.bfloat16)
         if input_features is None:
             return x
-        mel = jnp.asarray(np.asarray(input_features, np.float32))
+        mel = h2d(input_features, jnp.float32)
         if mel.ndim == 3:
             mel = mel[0]
         n_valid = (int(np.asarray(feature_attention_mask).sum())
@@ -909,7 +910,7 @@ class TPUQwen2_5OmniThinker:
         (idx,) = np.nonzero(ids == self.audio_token_id)
         assert len(idx) == audio.shape[0], (
             f"{len(idx)} audio tokens vs {audio.shape[0]} audio frames")
-        return x.at[0, jnp.asarray(idx)].set(audio.astype(x.dtype))
+        return x.at[0, h2d(idx)].set(audio.astype(x.dtype))
 
     def forward_logits(self, input_ids, input_features=None,
                        feature_attention_mask=None, **kwargs):
@@ -926,7 +927,7 @@ class TPUQwen2_5OmniThinker:
         )
         pos = jnp.arange(len(ids))[None, :]
         logits, _ = decoder_forward(
-            self.config, self.params, jnp.asarray(ids[None]), cache, pos,
+            self.config, self.params, h2d(ids[None]), cache, pos,
             input_embeds=x,
         )
         return logits
@@ -940,7 +941,7 @@ class TPUQwen2_5OmniThinker:
                                    feature_attention_mask)
         return _greedy_generate(
             self, ids, x, jnp.arange(n_p)[None, :],
-            lambda step: jnp.asarray([[n_p + step]], jnp.int32),
+            lambda step: h2d([[n_p + step]], jnp.int32),
             max_new_tokens,
         )
 
@@ -1009,12 +1010,12 @@ class TPUChatGLM4VForConditionalGeneration:
 
         ids = np.asarray(ids, np.int32).reshape(-1)
         L = len(ids)
-        x = embed_lookup(self.params["embed"], jnp.asarray(ids[None]),
+        x = embed_lookup(self.params["embed"], h2d(ids[None]),
                          jnp.bfloat16)
         pos = np.arange(L, dtype=np.int32)
         if pixel_values is None:
-            return x, jnp.asarray(pos[None]), L
-        px = jnp.asarray(np.asarray(pixel_values, np.float32))
+            return x, h2d(pos[None]), L
+        px = h2d(pixel_values, jnp.float32)
         if px.ndim == 3:
             px = px[None]
         img = eva_vision_forward(self.vision_config, self.vision_params, px)
@@ -1030,7 +1031,7 @@ class TPUChatGLM4VForConditionalGeneration:
             pos[eoi:],
         ])
         assert len(new_pos) == x.shape[1], (len(new_pos), x.shape)
-        return x, jnp.asarray(new_pos[None]), L
+        return x, h2d(new_pos[None]), L
 
     def forward_logits(self, input_ids, pixel_values=None, **kwargs):
         from ipex_llm_tpu import kv as kv_mod
@@ -1072,8 +1073,8 @@ class TPUChatGLM4VForConditionalGeneration:
                 break
             logits, cache = _mm_decode(
                 self.config, self.params, cache,
-                jnp.asarray([[tok]], jnp.int32),
-                jnp.asarray([[L + step]], jnp.int32),
+                h2d([[tok]], jnp.int32),
+                h2d([[L + step]], jnp.int32),
             )
             tok = int(jnp.argmax(logits[0, -1]))
         return np.asarray(out, np.int32)[None]
