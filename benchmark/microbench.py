@@ -21,9 +21,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ipex_llm_tpu.kv import PagedKVCache
 from ipex_llm_tpu.ops.linear import qmatmul_reference
 from ipex_llm_tpu.ops.pallas.qmatmul import qmatmul_pallas
 from ipex_llm_tpu.ops.pallas.decode_attention import decode_sdpa
+from ipex_llm_tpu.ops.pallas.paged_attention import paged_decode_sdpa
 from ipex_llm_tpu.ops.attention import sdpa_reference
 from ipex_llm_tpu.quantize import quantize
 
@@ -96,6 +98,76 @@ def bench_decode_attn(b, hq, hkv, s, d, dtype=jnp.bfloat16, iters=50):
             "xla_gbs": round(nbytes / tr / 1e9, 1)}
 
 
+def _paged_fixture(r, hkv, maxp, ps, d, dtype):
+    """A filled paged pool + per-row block tables: row i owns pages
+    [1 + i*maxp, 1 + (i+1)*maxp) (page 0 is the engine's scratch page).
+    The cache wraps the random pools directly — going through init would
+    allocate equal-size zero pools that sit dead in HBM for the run."""
+    rng = np.random.default_rng(0)
+    n_pages = 1 + r * maxp
+    tables = jnp.asarray(
+        1 + np.arange(r * maxp, dtype=np.int32).reshape(r, maxp))
+    k = jnp.asarray(rng.standard_normal((n_pages, hkv, ps, d)),
+                    jnp.float32).astype(dtype)
+    v = jnp.asarray(rng.standard_normal((n_pages, hkv, ps, d)),
+                    jnp.float32).astype(dtype)
+    cache = PagedKVCache(
+        k=k[None], v=v[None], tables=tables,
+        length=jnp.zeros((), jnp.int32),
+        storage="fp8" if dtype == jnp.float8_e5m2 else "bf16")
+    return cache, k, v
+
+
+def bench_paged_gather(r, hkv, maxp, ps, d, dtype=jnp.bfloat16, iters=50):
+    """The serving engine's XLA fallback read: pool layer -> head-major
+    [R, H, maxP*ps, D] row view (kv.PagedKVCache.gather_layer).  An fp8
+    pool gathers e5m2 codes — half the bytes of the bf16 gather this op
+    is tracked against."""
+    cache, k, _ = _paged_fixture(r, hkv, maxp, ps, d, dtype)
+    nbytes = r * maxp * ps * hkv * d * k.dtype.itemsize
+    f = jax.jit(lambda kl: cache.gather_layer(kl))
+    t = timeit(f, k, iters=iters)
+    print(f"paged_gather R={r} Hkv={hkv} P={maxp}x{ps} D={d} {k.dtype}: "
+          f"xla {t*1e6:8.1f}us ({nbytes/t/1e9:6.1f} GB/s)")
+    return {"op": f"paged_gather_r{r}_h{hkv}_s{maxp*ps}_d{d}_{k.dtype.name}",
+            "xla_us": round(t * 1e6, 1),
+            "xla_gbs": round(nbytes / t / 1e9, 1)}
+
+
+def bench_paged_decode_attn(r, hq, hkv, maxp, ps, d, dtype=jnp.bfloat16,
+                            iters=50):
+    """T=1 attention straight off the paged pool (the serving decode hot
+    path): the Pallas scalar-prefetch kernel streams each row's own pages
+    in storage dtype (fp8 tiles widen in-kernel) vs the gather-then-SDPA
+    XLA fallback."""
+    rng = np.random.default_rng(1)
+    cache, k, v = _paged_fixture(r, hkv, maxp, ps, d, dtype)
+    q = jnp.asarray(rng.standard_normal((r, 1, hq, d)), jnp.bfloat16)
+    kv_len = jnp.full((r,), maxp * ps, jnp.int32)
+    nbytes = 2 * r * maxp * ps * hkv * d * k.dtype.itemsize
+
+    f_kern = jax.jit(lambda q, k, v: paged_decode_sdpa(
+        q, k, v, cache.tables, kv_len))
+
+    def ref(q, k, v):
+        kd = cache.gather_layer(k).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        vd = cache.gather_layer(v).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        qpos = (kv_len - 1)[:, None]
+        return sdpa_reference(q, kd, vd, causal=True, q_positions=qpos,
+                              kv_len=kv_len)
+    f_ref = jax.jit(ref)
+    tk = timeit(f_kern, q, k, v, iters=iters)
+    tr = timeit(f_ref, q, k, v, iters=iters)
+    print(f"paged_decode_attn R={r} Hq={hq} Hkv={hkv} S={maxp*ps} D={d} "
+          f"{k.dtype}: kernel {tk*1e6:8.1f}us ({nbytes/tk/1e9:6.1f} GB/s) "
+          f"| xla {tr*1e6:8.1f}us ({nbytes/tr/1e9:6.1f} GB/s)")
+    return {"op": (f"paged_decode_attn_r{r}_h{hq}/{hkv}_s{maxp*ps}"
+                   f"_d{d}_{k.dtype.name}"),
+            "pallas_us": round(tk * 1e6, 1), "xla_us": round(tr * 1e6, 1),
+            "pallas_gbs": round(nbytes / tk / 1e9, 1),
+            "xla_gbs": round(nbytes / tr / 1e9, 1)}
+
+
 def collect(iters: int = 20) -> list[dict]:
     """Compact per-kernel summary for the BENCH artifact (fail-soft: an op
     whose kernel path is ineligible on this backend is skipped).
@@ -116,6 +188,14 @@ def collect(iters: int = 20) -> list[dict]:
             (bench_decode_attn, (1, 32, 32, 1280, 128), {"iters": iters}),
             (bench_decode_attn, (1, 32, 8, 4096, 128),
              {"dtype": jnp.float8_e5m2, "iters": iters}),         # fp8 KV
+            # paged serving pool: 16 rows x 16 pages of 128 slots
+            (bench_paged_gather, (16, 8, 16, 128, 128), {"iters": iters}),
+            (bench_paged_gather, (16, 8, 16, 128, 128),
+             {"dtype": jnp.float8_e5m2, "iters": iters}),
+            (bench_paged_decode_attn, (16, 32, 8, 16, 128, 128),
+             {"iters": iters}),
+            (bench_paged_decode_attn, (16, 32, 8, 16, 128, 128),
+             {"dtype": jnp.float8_e5m2, "iters": iters}),  # fp8 paged KV
         ]
     else:
         # interpret-mode shapes: small enough that the Pallas interpreter
@@ -125,6 +205,11 @@ def collect(iters: int = 20) -> list[dict]:
             (bench_decode_attn, (1, 8, 4, 256, 64), {"iters": 2}),
             (bench_decode_attn, (1, 8, 4, 256, 64),
              {"dtype": jnp.float8_e5m2, "iters": 2}),
+            (bench_paged_gather, (2, 4, 4, 32, 64), {"iters": 2}),
+            (bench_paged_gather, (2, 4, 4, 32, 64),
+             {"dtype": jnp.float8_e5m2, "iters": 2}),
+            (bench_paged_decode_attn, (2, 8, 4, 4, 32, 64),
+             {"dtype": jnp.float8_e5m2, "iters": 2}),     # fp8 paged KV
         ]
     for fn, args, kw in jobs:
         try:
@@ -152,3 +237,8 @@ if __name__ == "__main__":
     bench_decode_attn(1, 32, 32, 1280, 128)
     bench_decode_attn(1, 32, 8, 4096, 128)                 # GQA long
     bench_decode_attn(1, 32, 8, 4096, 128, jnp.float8_e5m2)  # fp8 KV
+    # paged serving pool (16 rows x 16 pages x 128 slots), bf16 vs fp8
+    bench_paged_gather(16, 8, 16, 128, 128)
+    bench_paged_gather(16, 8, 16, 128, 128, jnp.float8_e5m2)
+    bench_paged_decode_attn(16, 32, 8, 16, 128, 128)
+    bench_paged_decode_attn(16, 32, 8, 16, 128, 128, jnp.float8_e5m2)

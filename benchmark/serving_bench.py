@@ -35,12 +35,43 @@ def _percentile(xs, p):
     return float(np.percentile(np.asarray(xs, np.float64), p)) if xs else 0.0
 
 
+def _warm(eng, prompts, n_out: int = 4):
+    """Compile warm-up outside the timed window.  Callers pass DISTINCT
+    prompt draws: reusing a measured prompt would register its pages in
+    the prefix cache and hand that stream a cached prefill, skewing
+    TTFT/throughput."""
+    from ipex_llm_tpu.serving.engine import Request, stream_tokens
+
+    ws = [eng.submit(Request(prompt_ids=p, max_new_tokens=n_out))
+          for p in prompts]
+    for w in ws:
+        list(stream_tokens(w, timeout=1800))
+
+
+def _run_wave(eng, reqs, outs, key_offset: int = 0,
+              timeout: float = 1800.0):
+    """Submit ``reqs`` and drain each stream in its own thread (one
+    concurrent wave); results land in ``outs[key_offset + i]``."""
+    from ipex_llm_tpu.serving.engine import stream_tokens
+
+    def drain(i, r):
+        outs[key_offset + i] = list(stream_tokens(r, timeout=timeout))
+
+    threads = []
+    for i, r in enumerate(reqs):
+        eng.submit(r)
+        th = threading.Thread(target=drain, args=(i, r))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout)
+
+
 def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
                 n_out: int, seed: int = 0) -> dict:
     """One concurrency level through a fresh engine (fresh prefix cache and
     page pool so levels don't subsidise each other)."""
-    from ipex_llm_tpu.serving.engine import (Request, ServingEngine,
-                                             stream_tokens)
+    from ipex_llm_tpu.serving.engine import Request, ServingEngine
 
     rng = np.random.default_rng(seed)
     prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
@@ -58,27 +89,13 @@ def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
         # h=1 fused variant (the admission-wave fallback) in addition to
         # the steady h=H program — otherwise the first measured wave pays
         # that compile inside the timed window
-        ws = [eng.submit(Request(prompt_ids=p, max_new_tokens=4))
-              for p in warm_prompts]
-        for w in ws:
-            list(stream_tokens(w, timeout=1800))
+        _warm(eng, warm_prompts)
 
         reqs = [Request(prompt_ids=p, max_new_tokens=n_out) for p in prompts]
         outs: dict[int, list[int]] = {}
-
-        def drain(i, r):
-            outs[i] = list(stream_tokens(r, timeout=1800))
-
         m0 = dict(eng.metrics)  # window-scope the sync counters (no warm-up)
         t0 = time.perf_counter()
-        threads = []
-        for i, r in enumerate(reqs):
-            eng.submit(r)
-            th = threading.Thread(target=drain, args=(i, r))
-            th.start()
-            threads.append(th)
-        for th in threads:
-            th.join(timeout=1800)
+        _run_wave(eng, reqs, outs)
         wall = time.perf_counter() - t0
 
         total_tokens = sum(len(v) for v in outs.values())
@@ -108,6 +125,76 @@ def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
             "steps_per_sync": round(steps_w / max(syncs_w, 1), 2),
             "host_sync_s": round(
                 m.get("host_sync_s", 0.0) - m0.get("host_sync_s", 0.0), 6),
+            "completed": sum(
+                1 for r in reqs if r.finish_reason in ("length", "stop")),
+        }
+    finally:
+        eng.stop()
+
+
+def bench_kv_storage(cfg, params, engine_config, concurrency: int,
+                     n_in: int, n_out: int, seed: int = 11) -> dict:
+    """Fixed-byte-budget KV-storage row: TWO waves of ``concurrency``
+    streams, wave B repeating wave A's prompts — so the prefix cache gets
+    a real reuse opportunity and the row measures what the storage width
+    buys at a FIXED ``kv_pool_bytes``: fp8 pools hold 2x the pages, so
+    wave A's cached prefix pages survive to wave B (hit rate up,
+    evictions down) and horizon pre-allocation stops clamping.  The
+    engine_config must carry ``kv_pool_bytes`` + ``kv_storage``; bf16 and
+    fp8 rows at the same budget are judged against each other."""
+    from ipex_llm_tpu.serving.engine import Request, ServingEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+               for _ in range(concurrency)]
+    warm_prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+                    for _ in range(2)]
+    eng = ServingEngine(cfg, params, engine_config).start()
+    try:
+        _warm(eng, warm_prompts)
+
+        reqs: list[Request] = []
+        outs: dict[int, list[int]] = {}
+        # window-scope every reported counter past the warm-up (same
+        # policy as bench_churn's m0): warm-up requests must not dilute
+        # the hit rate or smuggle their evictions into the row
+        m0 = dict(eng.metrics)
+        kv0 = eng.kv_stats()
+        t0 = time.perf_counter()
+        for wave in range(2):       # wave B re-sends wave A's prompts
+            wave_reqs = [Request(prompt_ids=p, max_new_tokens=n_out)
+                         for p in prompts]
+            reqs.extend(wave_reqs)
+            _run_wave(eng, wave_reqs, outs, key_offset=wave * concurrency)
+        wall = time.perf_counter() - t0
+
+        m = eng.metrics
+        kv = eng.kv_stats()
+        total_tokens = sum(len(v) for v in outs.values())
+        ttfts = [r.first_token_s for r in reqs if r.first_token_s > 0]
+        return {
+            "workload": "kv_budget",
+            "kv_storage": kv["storage"],
+            "kv_pool_bytes": engine_config.kv_pool_bytes,
+            "pages_total": kv["pages_total"],
+            "concurrency": concurrency,
+            "n_in": n_in,
+            "n_out": n_out,
+            "decode_horizon": engine_config.decode_horizon,
+            "agg_tok_s": round(total_tokens / wall, 2),
+            "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+            "ttft_p95_s": round(_percentile(ttfts, 95), 4),
+            # capacity-pressure trio the storage width moves at a fixed
+            # byte budget: prefix reuse across the waves, cached pages
+            # lost to pool pressure, and allocation-failure clamps
+            "prefix_hit_rate": round(
+                (m["prefix_hits"] - m0["prefix_hits"])
+                / max(m["requests"] - m0["requests"], 1), 3),
+            "prefix_evictions": (kv["prefix_evictions"]
+                                 - kv0["prefix_evictions"]),
+            "alloc_fail_clamps": (kv["alloc_fail_clamps"]
+                                  - kv0["alloc_fail_clamps"]),
+            "horizon_clamps": kv["horizon_clamped"] - kv0["horizon_clamped"],
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
         }
@@ -149,11 +236,8 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
         # mixed-length prompts walks the admission path through its
         # (batch, width) program variants as rows join and complete, plus
         # the steady-state decode — compiles stay out of the timed window
-        ws = [eng.submit(Request(
-            prompt_ids=list(rng.integers(1, cfg.vocab_size, n).astype(int)),
-            max_new_tokens=4)) for n in prompt_lens]
-        for w in ws:
-            list(stream_tokens(w, timeout=1800))
+        _warm(eng, [list(rng.integers(1, cfg.vocab_size, n).astype(int))
+                    for n in prompt_lens])
 
         sem = threading.Semaphore(concurrency)
         reqs: list[Request] = []
@@ -339,12 +423,43 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
         except Exception as e:  # noqa: BLE001
             print(f"serving_bench skip churn budget={budget}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+    # fixed-byte-budget KV-storage sweep (bf16 vs fp8) at the ladder's top
+    # concurrency: the pool budget is sized to JUST fit one wave of bf16
+    # requests, so the bf16 row shows the pressure symptoms (prefix
+    # evictions between the repeat waves, allocation-failure clamps) that
+    # the fp8 row's doubled page count — same bytes, half the width —
+    # avoids.  The two rows are judged against each other in-run.
+    from ipex_llm_tpu.kv import paged_page_bytes
+
+    kv_c = max(levels)
+    kv_in = 4 * n_in                             # prompts span >=4 pages
+    kv_ps = min(ec.page_size, max(32, n_in))
+    f_pages = -(-(kv_in + n_out) // kv_ps)       # per-request footprint
+    kv_budget = (kv_c * f_pages + 2) * paged_page_bytes(
+        cfg.num_layers, cfg.num_kv_heads, kv_ps, cfg.head_dim,
+        v_head_dim=cfg.v_dim, storage="bf16")
+    kv_seq = 1 << (kv_in + n_out - 1).bit_length()
+    kv_ec = _dc_replace(ec, page_size=kv_ps, max_seq_len=max(kv_seq, 256),
+                        decode_horizon=churn_h, kv_pool_bytes=kv_budget)
+    for storage in ("bf16", "fp8"):
+        try:
+            runs = [bench_kv_storage(
+                cfg, params, _dc_replace(kv_ec, kv_storage=storage),
+                kv_c, kv_in, n_out, seed=11 + rep) for rep in range(reps)]
+            runs.sort(key=lambda r: r["agg_tok_s"])
+            row = runs[len(runs) // 2]
+            row["agg_tok_s_all"] = [r["agg_tok_s"] for r in runs]
+            out.append(row)
+        except Exception as e:  # noqa: BLE001
+            print(f"serving_bench skip kv_storage={storage}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
     return out
 
 
 def chaos(cfg=None, params=None, every: int = 5,
           site: str = "decode-dispatch", n_reqs: int | None = None,
-          stream_timeout_s: float = 300.0) -> tuple[dict, bool]:
+          stream_timeout_s: float = 300.0,
+          kv_storage: str = "bf16") -> tuple[dict, bool]:
     """Chaos-mode churn (``--inject-faults``): transient faults fire at a
     deterministic rate (every Nth hit of ``site``) during the churn
     workload, and the run is a STRESS GATE — it passes only when the
@@ -375,6 +490,9 @@ def chaos(cfg=None, params=None, every: int = 5,
         prefill_bucket=min(256, max(32, n_in)),
         decode_horizon=int(os.environ.get("BENCH_CHURN_HORIZON", "8")),
         retry_backoff_s=0.005,
+        # --kv-storage fp8 runs the whole fault-injection stress path
+        # (rollback, retry, bisection snapshots) over the quantized pool
+        kv_storage=kv_storage,
     )
     injector = rate_injector(site, every, TransientFault, limit=None)
     row = bench_churn(cfg, params, ec, concurrency=4, n_reqs=n_reqs,
@@ -383,6 +501,7 @@ def chaos(cfg=None, params=None, every: int = 5,
                       stream_timeout_s=stream_timeout_s)
     row["fault_site"] = site
     row["fault_every"] = every
+    row["kv_storage"] = kv_storage
     # the gate: injected transients must be absorbed by retries — any
     # request-visible error, engine-level failure, incomplete stream, or
     # hang means the fault domain leaked
@@ -415,6 +534,10 @@ if __name__ == "__main__":
     ap.add_argument("--fault-site", default="decode-dispatch",
                     help="guarded engine site the chaos faults fire at "
                          "(see ipex_llm_tpu.serving.faults.FAULT_SITES)")
+    ap.add_argument("--kv-storage", default="bf16",
+                    choices=("bf16", "fp8"),
+                    help="KV pool storage the chaos gate runs over — fp8 "
+                         "covers rollback/retry on the quantized pool")
     args = ap.parse_args()
 
     # probe in a subprocess FIRST: a wedged axon tunnel hangs backend init
@@ -423,7 +546,8 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
     print("backend:", jax.default_backend(), file=sys.stderr)
     if args.inject_faults is not None:
-        row, passed = chaos(every=args.inject_faults, site=args.fault_site)
+        row, passed = chaos(every=args.inject_faults, site=args.fault_site,
+                            kv_storage=args.kv_storage)
         print(json.dumps(row))
         sys.exit(0 if passed else 1)
     for row in collect():

@@ -29,6 +29,39 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+# KV storage formats: the engine axis this module exposes.  "bf16" is the
+# full-width default; "fp8" stores e5m2 codes (the reference DynamicFp8Cache
+# format) — half the bytes per slot, so a byte-budgeted paged pool holds
+# exactly twice the pages.  Dequant happens next to the attention op (the
+# Pallas kernels widen tiles in-kernel; the XLA fallback casts the gathered
+# layer once).
+KV_STORAGE_DTYPES = {
+    "bf16": jnp.bfloat16,
+    "fp8": jnp.float8_e5m2,
+}
+
+
+def kv_storage_dtype(storage: str):
+    """Storage-format name -> pool dtype; raises listing the valid names."""
+    try:
+        return KV_STORAGE_DTYPES[storage]
+    except KeyError:
+        raise ValueError(
+            f"unknown kv storage {storage!r}: valid storages are "
+            f"{sorted(KV_STORAGE_DTYPES)}") from None
+
+
+def paged_page_bytes(n_layers: int, n_kv_heads: int, page_size: int,
+                     head_dim: int, v_head_dim: int | None = None,
+                     storage: str = "bf16") -> int:
+    """Bytes ONE page occupies across all layers and both k/v pools — the
+    unit the serving engine's ``kv_pool_bytes`` budget divides by (so the
+    page count, and with it effective batch capacity, follows the storage
+    width: fp8 => 2x the pages of bf16 at the same byte budget)."""
+    vd = v_head_dim if v_head_dim is not None else head_dim
+    itemsize = jnp.dtype(kv_storage_dtype(storage)).itemsize
+    return n_layers * n_kv_heads * page_size * (head_dim + vd) * itemsize
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
@@ -162,15 +195,44 @@ class PagedKVCache:
     @classmethod
     def init(cls, n_layers: int, n_pages: int, n_rows: int, max_pages: int,
              n_kv_heads: int, page_size: int, head_dim: int,
-             dtype=jnp.bfloat16, v_head_dim: int | None = None):
+             dtype=None, v_head_dim: int | None = None,
+             storage: str | None = None):
+        """``storage`` selects the pool width ("bf16" | "fp8" e5m2); the
+        whole access surface (encode/decode_layer/update/gather) keys off
+        ``self.k.dtype``, so one class serves both formats — the serving
+        engine's Fp8 pool is this init with ``storage="fp8"``.  An
+        explicit ``dtype`` must itself be a storage format: with
+        ``storage=None`` (default) the tag is derived from it, and a
+        contradictory explicit pair raises — ``self.storage`` can never
+        lie about what the pool holds."""
         vd = v_head_dim if v_head_dim is not None else head_dim
+        if storage is None:
+            if dtype is None:
+                storage, dtype = "bf16", jnp.bfloat16
+            else:
+                match = [n for n, d in KV_STORAGE_DTYPES.items()
+                         if jnp.dtype(d) == jnp.dtype(dtype)]
+                if not match:
+                    raise ValueError(
+                        f"dtype {jnp.dtype(dtype).name} is not a kv "
+                        f"storage format: valid storages are "
+                        f"{sorted(KV_STORAGE_DTYPES)}")
+                storage = match[0]
+        else:
+            storage_dtype = kv_storage_dtype(storage)  # validates the name
+            if dtype is None:
+                dtype = storage_dtype
+            elif jnp.dtype(dtype) != jnp.dtype(storage_dtype):
+                raise ValueError(
+                    f"dtype {jnp.dtype(dtype).name} contradicts "
+                    f"storage {storage!r} ({jnp.dtype(storage_dtype).name})")
         return cls(
             k=jnp.zeros((n_layers, n_pages, n_kv_heads, page_size, head_dim),
                         dtype),
             v=jnp.zeros((n_layers, n_pages, n_kv_heads, page_size, vd), dtype),
             tables=jnp.full((n_rows, max_pages), -1, jnp.int32),
             length=jnp.zeros((), jnp.int32),
-            storage="bf16",
+            storage=storage,
         )
 
     @property
@@ -180,6 +242,21 @@ class PagedKVCache:
     @property
     def max_len(self) -> int:
         return self.tables.shape[1] * self.page_size
+
+    @property
+    def page_bytes(self) -> int:
+        """Bytes one page occupies across all layers and both pools (the
+        byte-budget unit the engine sizes ``kv_pool_bytes`` with — one
+        formula, :func:`paged_page_bytes`; init guarantees the storage
+        tag matches the pool dtypes)."""
+        l, _, h, ps, d = self.k.shape
+        return paged_page_bytes(l, h, ps, d, v_head_dim=self.v.shape[4],
+                                storage=self.storage)
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total k+v pool footprint in bytes."""
+        return self.page_bytes * self.k.shape[1]
 
     def encode(self, x: jnp.ndarray) -> jnp.ndarray:
         return x.astype(self.k.dtype)
@@ -244,13 +321,26 @@ class PagedKVCache:
         return replace(self, length=self.length + n)
 
 
+# cache-kind registry: name -> constructor.  Dense kinds take the KVCache
+# init signature; paged kinds take PagedKVCache.init's (the serving pool).
+# The compress/SnapKV variant lives in ipex_llm_tpu.compresskv.
+CACHE_KINDS = {
+    "normal": KVCache.init,
+    "fp8": Fp8KVCache.init,
+    "paged": PagedKVCache.init,
+    "paged_fp8": lambda *a, **kw: PagedKVCache.init(*a, storage="fp8", **kw),
+}
+
+
 def make_cache(kind: str, *args: Any, **kwargs: Any) -> KVCache:
-    """kind: 'normal' | 'fp8' (compress/SnapKV variant: see ipex_llm_tpu.compresskv)."""
-    if kind == "normal":
-        return KVCache.init(*args, **kwargs)
-    if kind == "fp8":
-        return Fp8KVCache.init(*args, **kwargs)
-    raise ValueError(f"unknown kv cache kind {kind!r}")
+    """kind: 'normal' | 'fp8' (dense) | 'paged' | 'paged_fp8' (pool)."""
+    try:
+        ctor = CACHE_KINDS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown kv cache kind {kind!r}: valid kinds are "
+            f"{sorted(CACHE_KINDS)}") from None
+    return ctor(*args, **kwargs)
 
 
 def use_quantize_kv_cache() -> bool:

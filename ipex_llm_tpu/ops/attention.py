@@ -180,6 +180,11 @@ def cached_sdpa(
     if hasattr(cache, "tables"):
         # paged pool layer (serving engine; rows right-aligned from slot 0,
         # queries at slots [kv_len - T, kv_len) — the engine's invariant).
+        # The layer arrives in STORAGE dtype: an fp8(e5m2) pool streams its
+        # tiles into the Pallas kernels, which widen to bf16 in-kernel (the
+        # ``xe_addons.sdp_fp8`` equivalent — HBM reads stay half-width),
+        # and the gather fallback gathers the fp8 codes (still half the
+        # bytes) before ``decode_layer`` casts once next to the op.
         # The mixed prefill+decode step rides this same path with a RAGGED
         # right-padded chunk: each row's real queries are a PREFIX of its
         # [kv_len - T, kv_len) window (a decode row has 1, a prefill row up

@@ -12,6 +12,13 @@ engine's scratch page 0 and are masked by ``kv_len``).
 Same online-softmax structure as ops/pallas/decode_attention.py; rows are
 right-aligned from slot 0 (the paged engine's invariant), so there is no
 ``kv_start``.
+
+K/V tiles stream in the pool's STORAGE dtype: an fp8(e5m2) pool
+(``EngineConfig.kv_storage="fp8"``) is read as e5m2 codes and widened to
+the compute dtype *inside* the kernel — the paged form of
+``xe_addons.sdp_fp8`` (reference models/utils.py:102-192), so fp8 KV
+actually halves the decode path's HBM traffic rather than paying a
+full-width materialization before attention.
 """
 
 from __future__ import annotations

@@ -417,6 +417,12 @@ class OpenAIServer:
             "prefill_tokens_per_step": m.get("prefill_tokens_per_step", 0.0),
             "ttft_p95_s": m.get("ttft_p95_s", 0.0),
         }
+        # KV-pool economics: storage format and byte footprint, occupancy,
+        # and the pressure trace (prefix-cache LRU evictions, allocation-
+        # failure clamps) — what the fp8-vs-bf16 fixed-budget story is
+        # operated on (capacity planning reads pool_bytes/pages_total,
+        # incident triage reads the clamp/eviction counters)
+        body["kv"] = self.engine.kv_stats()
         # fault-domain observability: admission backlog vs the bound (what
         # a 429 means), per-request failures isolated by bisection,
         # transient step retries, load-shed and deadline-expired counts
@@ -659,6 +665,17 @@ def main(argv=None):
                          "one device program.  Default: the prefill "
                          "bucket; 0 reverts to sequential one-row-one-"
                          "chunk admission")
+    ap.add_argument("--kv-storage", default="bf16",
+                    choices=("bf16", "fp8"), metavar="FMT",
+                    help="paged KV pool storage format: bf16 (full width, "
+                         "default) or fp8 (e5m2 codes — half the KV bytes "
+                         "per token, twice the pages per byte budget; "
+                         "slightly lossy vs bf16)")
+    ap.add_argument("--kv-pool-bytes", type=int, default=0, metavar="BYTES",
+                    help="KV pool byte budget: pool page count is derived "
+                         "as BYTES / page_bytes(model, --kv-storage), so "
+                         "fp8 automatically holds 2x the pages.  0 = size "
+                         "in pages (the auto heuristic)")
     ap.add_argument("--max-queue", type=int, default=256,
                     help="bounded admission queue: submissions beyond this "
                          "many waiting requests are load-shed with HTTP "
@@ -684,6 +701,8 @@ def main(argv=None):
                      spec_k=args.speculative,
                      decode_horizon=args.decode_horizon,
                      step_token_budget=args.step_token_budget,
+                     kv_storage=args.kv_storage,
+                     kv_pool_bytes=args.kv_pool_bytes,
                      max_queue=args.max_queue,
                      request_deadline_s=args.request_deadline,
                      max_step_retries=args.max_step_retries),

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ipex_llm_tpu.hostutil import d2h, h2d
-from ipex_llm_tpu.kv import PagedKVCache
+from ipex_llm_tpu.kv import PagedKVCache, paged_page_bytes
 from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
 from ipex_llm_tpu.serving.faults import (EngineOverloaded, FaultInjector,
@@ -67,6 +67,23 @@ class EngineConfig:
     max_seq_len: int = 4096     # per-row KV capacity (block-table width)
     page_size: int = 128        # KV page length (slots)
     pool_pages: int = 0         # 0 = auto: max_rows * max_seq_len / page / 2
+    # KV pool storage format (kv.KV_STORAGE_DTYPES): "bf16" full width, or
+    # "fp8" e5m2 codes — the reference DynamicFp8Cache / xe_addons.sdp_fp8
+    # format on the PAGED pool.  Decode is KV-bandwidth-bound, so fp8
+    # halves the per-token HBM read; at a fixed kv_pool_bytes budget it
+    # also doubles the page count — fewer pool-contention horizon clamps,
+    # fewer prefix-cache LRU evictions, higher sustainable concurrency.
+    # e5m2 storage is lossy vs bf16 (engine output stays bit-identical
+    # ACROSS engine paths — mixed/sequential, H8/H1 — for a given
+    # storage); see docs/quickstart/serving.md "KV storage & memory
+    # budget" for the quality expectations.
+    kv_storage: str = "bf16"
+    # KV pool byte budget: when > 0, the pool page count is DERIVED as
+    # kv_pool_bytes // page_bytes(model, page_size, kv_storage), so
+    # capacity follows the storage width automatically (fp8 => 2x pages)
+    # and operators size the pool in the unit they actually provision
+    # (HBM bytes).  Overrides pool_pages.  0 = pool_pages/auto sizing.
+    kv_pool_bytes: int = 0
     prefill_bucket: int = 128   # chunked-prefill chunk length
     # speculative serving (reference ipex_llm_worker.py:57 `speculative`
     # load flag): >0 enables prompt-lookup speculative decode steps — each
@@ -191,6 +208,10 @@ class PageAllocator:
         # prefix cache: chain-hash -> page id; insertion order ~ LRU
         self.prefix: "OrderedDict[bytes, int]" = OrderedDict()
         self._page_key: dict[int, bytes] = {}
+        # pool-pressure trace: cached prefix pages dropped to satisfy new
+        # allocations (each one is a future prefix miss a bigger pool —
+        # or a narrower storage — would have kept)
+        self.prefix_evictions = 0
 
     def alloc(self) -> int | None:
         if not self.free and not self._evict_one():
@@ -214,6 +235,7 @@ class PageAllocator:
                 del self.prefix[key]
                 del self._page_key[pid]
                 self.decref(pid)
+                self.prefix_evictions += 1
                 return True
         return False
 
@@ -573,13 +595,40 @@ class ServingEngine:
                 and self.ec.step_token_budget < 0):
             raise ValueError("step_token_budget must be >= 0 (0 disables "
                              "the mixed prefill+decode step)")
+        if self.ec.kv_pool_bytes < 0:
+            raise ValueError("kv_pool_bytes must be >= 0 (0 = size the "
+                             "pool in pages via pool_pages)")
+        # KV storage axis: bytes ONE page costs at this model shape and
+        # storage width — the unit kv_pool_bytes divides by (validates
+        # kv_storage, raising with the valid names)
+        self.page_bytes = paged_page_bytes(
+            cfg.num_layers, cfg.num_kv_heads, self.ec.page_size,
+            cfg.head_dim, v_head_dim=cfg.v_dim,
+            storage=self.ec.kv_storage)
+        if self.ec.kv_pool_bytes:
+            # byte-budgeted pool: capacity in pages follows the storage
+            # width (fp8 pages are half the bytes => twice the pages)
+            pages = self.ec.kv_pool_bytes // self.page_bytes
+            floor = self.ec.max_rows + 2   # one page per row + scratch
+            if pages < floor:
+                # refuse rather than silently overshoot the operator's
+                # explicit byte cap: the budget cannot even back one page
+                # per row — shrink max_rows, the page size, or the model,
+                # or switch to fp8 storage (half the bytes per page)
+                raise ValueError(
+                    f"kv_pool_bytes={self.ec.kv_pool_bytes} holds only "
+                    f"{pages} {self.ec.kv_storage} pages of "
+                    f"{self.page_bytes} bytes — max_rows={self.ec.max_rows}"
+                    f" needs at least {floor} ({floor * self.page_bytes} "
+                    f"bytes)")
+            self.ec = replace(self.ec, pool_pages=pages)
         self.default_eos = default_eos
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         r = self.ec.max_rows
         cache = PagedKVCache.init(
             cfg.num_layers, self.ec.n_pages, r, self.ec.max_pages,
             cfg.num_kv_heads, self.ec.page_size, cfg.head_dim,
-            v_head_dim=cfg.v_dim,
+            v_head_dim=cfg.v_dim, storage=self.ec.kv_storage,
         )
         if self.mesh is not None:
             from ipex_llm_tpu.parallel.shard import (shard_paged_cache,
@@ -689,7 +738,11 @@ class ServingEngine:
                         # load-shed submissions, expired deadlines, and
                         # the current admission backlog
                         "errors_isolated": 0, "retries": 0, "rejected": 0,
-                        "timeouts": 0, "queue_depth": 0}
+                        "timeouts": 0, "queue_depth": 0,
+                        # kv-pool pressure: allocation failures that forced
+                        # a clamp/fallback (paired with the allocator's
+                        # prefix_evictions in /health's kv block)
+                        "alloc_fail_clamps": 0}
 
     # -- public API ---------------------------------------------------------
 
@@ -730,6 +783,27 @@ class ServingEngine:
     def queue_depth(self) -> int:
         """Requests waiting for a row (inbox + pending, not in-flight)."""
         return self._inbox.qsize() + len(self._pending)
+
+    def kv_stats(self) -> dict:
+        """KV-pool observability for /health and the bench sweeps: what
+        the pool costs (storage format, page/pool bytes), how full it is,
+        and the pressure trace (prefix-cache LRU evictions, allocation
+        failures that forced a clamp) — the numbers the fp8-vs-bf16
+        fixed-byte-budget story is judged on."""
+        a = self.alloc
+        return {
+            "storage": self.ec.kv_storage,
+            "page_size": self.ec.page_size,
+            "pages_total": a.n_pages,       # page 0 = reserved scratch
+            "pages_free": len(a.free),
+            "pages_in_use": a.pages_in_use,
+            "page_bytes": self.page_bytes,
+            "pool_bytes": a.n_pages * self.page_bytes,
+            "prefix_pages_cached": len(a.prefix),
+            "prefix_evictions": a.prefix_evictions,
+            "alloc_fail_clamps": self.metrics.get("alloc_fail_clamps", 0),
+            "horizon_clamped": self.metrics.get("horizon_clamped", 0),
+        }
 
     @property
     def draining(self) -> bool:
@@ -819,7 +893,8 @@ class ServingEngine:
             "pending": list(self._pending),
             "alloc": (list(self.alloc.free), self.alloc.ref.copy(),
                       OrderedDict(self.alloc.prefix),
-                      dict(self.alloc._page_key)),
+                      dict(self.alloc._page_key),
+                      self.alloc.prefix_evictions),
             "key": self.key,
             "metrics": dict(self.metrics),
             "ttfts": list(self._ttfts),
@@ -844,11 +919,12 @@ class ServingEngine:
         self.tables = snap["tables"].copy()
         self._prefilling = dict(snap["prefilling"])
         self._row_keys = dict(snap["row_keys"])
-        free, ref, prefix, pkey = snap["alloc"]
+        free, ref, prefix, pkey, evictions = snap["alloc"]
         self.alloc.free = list(free)
         self.alloc.ref = ref.copy()
         self.alloc.prefix = OrderedDict(prefix)
         self.alloc._page_key = dict(pkey)
+        self.alloc.prefix_evictions = evictions
         self.key = snap["key"]
         # the rolling TTFT window reverts too: a first token recorded by
         # the doomed tick (or a bisection probe) was never emitted, and the
@@ -1069,6 +1145,12 @@ class ServingEngine:
             if self.tables[row, j] < 0:
                 pid = self.alloc.alloc()
                 if pid is None:
+                    # every caller clamps on a dry pool (shorter horizon,
+                    # requeued admission, spec fallback, 'length' finish);
+                    # count the event so pool pressure is visible in
+                    # /health's kv block instead of only via its symptoms
+                    self.metrics["alloc_fail_clamps"] = (
+                        self.metrics.get("alloc_fail_clamps", 0) + 1)
                     return False
                 self.tables[row, j] = pid
                 # page allocation only touches THIS row's table: a dirty-
@@ -1238,9 +1320,6 @@ class ServingEngine:
                 self.tables[row, i] = pid
                 self._dirty_tables.add(row)
                 shared += 1
-            if shared:
-                self.metrics["prefix_hits"] += 1
-                self.metrics["prefix_pages_shared"] += shared
 
             base = shared * ps
             if not self._ensure_pages(row, n_p, req=req):
@@ -1258,6 +1337,14 @@ class ServingEngine:
                     self._queue_put(req, None)
                 return
 
+            if shared:
+                # counted only on successful admission: a dry-pool
+                # requeue above releases the shared refs and re-admits
+                # the same request later — bumping here would count that
+                # request's hits twice (hit rate could exceed 1.0 under
+                # exactly the pool pressure the kv sweep measures)
+                self.metrics["prefix_hits"] += 1
+                self.metrics["prefix_pages_shared"] += shared
             self.rows[row] = req
             self.row_lens[row] = base
             self.row_budget[row] = req.max_new_tokens

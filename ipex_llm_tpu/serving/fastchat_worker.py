@@ -256,7 +256,8 @@ def build_worker(model_path: str, low_bit: str = "sym_int4",
                  worker_addr: str = "http://localhost:21002",
                  model_names: list[str] | None = None,
                  limit_worker_concurrency: int = 8,
-                 drain_timeout_s: float = 30.0) -> FastChatWorker:
+                 drain_timeout_s: float = 30.0,
+                 engine_config: EngineConfig | None = None) -> FastChatWorker:
     from transformers import AutoTokenizer
 
     from ipex_llm_tpu.transformers import AutoModelForCausalLM
@@ -267,6 +268,7 @@ def build_worker(model_path: str, low_bit: str = "sym_int4",
     names = model_names or [model_path.rstrip("/").split("/")[-1]]
     return FastChatWorker(model, tok, names, controller_addr, worker_addr,
                           limit_worker_concurrency,
+                          engine_config=engine_config,
                           drain_timeout_s=drain_timeout_s)
 
 
@@ -280,6 +282,15 @@ def main(argv=None):
     ap.add_argument("--worker-address", default=None)
     ap.add_argument("--model-names", default=None)
     ap.add_argument("--limit-worker-concurrency", type=int, default=8)
+    ap.add_argument("--kv-storage", default="bf16",
+                    choices=("bf16", "fp8"), metavar="FMT",
+                    help="paged KV pool storage: bf16 (default) or fp8 "
+                         "e5m2 (half the KV bytes, twice the pages per "
+                         "byte budget; slightly lossy)")
+    ap.add_argument("--kv-pool-bytes", type=int, default=0, metavar="BYTES",
+                    help="KV pool byte budget (page count derived from "
+                         "bytes / page size at --kv-storage width; 0 = "
+                         "auto page sizing)")
     ap.add_argument("--no-register", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=30.0,
                     metavar="SECONDS",
@@ -291,7 +302,11 @@ def main(argv=None):
     w = build_worker(args.model_path, args.low_bit,
                      None if args.no_register else args.controller_address,
                      worker_addr, names, args.limit_worker_concurrency,
-                     drain_timeout_s=args.drain_timeout)
+                     drain_timeout_s=args.drain_timeout,
+                     engine_config=EngineConfig(
+                         max_rows=args.limit_worker_concurrency,
+                         kv_storage=args.kv_storage,
+                         kv_pool_bytes=args.kv_pool_bytes))
     if w.controller_addr:
         async def on_start(app):
             app["hb"] = asyncio.create_task(w.heartbeat_loop())

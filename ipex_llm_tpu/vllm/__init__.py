@@ -110,6 +110,7 @@ class LLM:
                  trust_remote_code: bool = True, dtype: str = "auto",
                  max_model_len: int = 4096, max_num_seqs: int = 8,
                  tensor_parallel_size: int = 1,
+                 kv_cache_dtype: str = "auto",
                  **kwargs: Any):
         from transformers import AutoTokenizer
 
@@ -138,9 +139,19 @@ class LLM:
         eos = self._model.generation_config.eos_token_id
         self._eos = tuple(eos) if isinstance(eos, (list, tuple)) else (
             (eos,) if eos is not None else ())
+        # vLLM's kv_cache_dtype spelling -> the engine's kv_storage axis
+        # ("fp8"/"fp8_e5m2" = e5m2 paged pool, the DynamicFp8Cache format)
+        kv_storage = {"auto": "bf16", "bf16": "bf16",
+                      "fp8": "fp8", "fp8_e5m2": "fp8"}.get(
+            kv_cache_dtype.lower())
+        if kv_storage is None:
+            raise ValueError(
+                f"unsupported kv_cache_dtype {kv_cache_dtype!r}: use "
+                f"'auto', 'bf16', 'fp8', or 'fp8_e5m2'")
         self._engine = ServingEngine(
             self._model.config, self._model.params,
-            EngineConfig(max_rows=max_num_seqs, max_seq_len=max_model_len),
+            EngineConfig(max_rows=max_num_seqs, max_seq_len=max_model_len,
+                         kv_storage=kv_storage),
             default_eos=self._eos, mesh=mesh,
         ).start()
 
