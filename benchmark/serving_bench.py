@@ -202,6 +202,19 @@ def bench_kv_storage(cfg, params, engine_config, concurrency: int,
         eng.stop()
 
 
+def _audited_tick_dispatches():
+    """Static dispatch count of one mixed tick, from the jaxprcheck tick
+    audit (None only if the analysis package is unimportable — the bench
+    must keep running on a stripped install)."""
+    try:
+        from ipex_llm_tpu.analysis.trace.tickaudit import \
+            mixed_tick_dispatch_count
+
+        return mixed_tick_dispatch_count()
+    except Exception:
+        return None
+
+
 def bench_churn(cfg, params, engine_config, concurrency: int = 4,
                 n_reqs: int = 8, n_out: int = 16,
                 prompt_lens=(24, 48, 72, 96), gap_s: float = 0.05,
@@ -295,6 +308,12 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
             # means the engine blocked at least once per token
             "syncs_per_token": round(syncs_w / max(total_tokens, 1), 3),
             "mixed_steps": m.get("mixed_steps", 0) - m0.get("mixed_steps", 0),
+            # the AUDITED per-tick dispatch count (jaxprcheck JP106 gate,
+            # analysis/trace/tickaudit.py): how many device programs one
+            # mixed prefill+decode tick can issue — 2 today; the ragged
+            # paged-attention superkernel roadmap item drives it to 1, and
+            # BENCH_r06+ tracks the value next to the throughput it buys
+            "tick_dispatches": _audited_tick_dispatches(),
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
         }

@@ -1,42 +1,26 @@
-"""CLI for jaxlint: ``python -m ipex_llm_tpu.analysis [paths...]``.
+"""CLI for both analysis tiers.
 
-Exit codes: 0 clean (warnings allowed), 1 unsuppressed error-tier
-findings, 2 usage error.
+``python -m ipex_llm_tpu.analysis [paths...]``   AST tier (jaxlint)
+``python -m ipex_llm_tpu.analysis --trace``      trace tier (jaxprcheck):
+    abstract-trace the registered hot-path jitted programs and gate their
+    compiled-program properties against analysis/programs.lock.json.
+
+Exit codes (both tiers): 0 clean (warnings allowed), 1 unsuppressed
+error-tier findings, 2 usage error, 3 internal analyzer error — CI can
+tell "the gate failed" from "the gate itself is broken".
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import traceback
 from pathlib import Path
 
 from ipex_llm_tpu.analysis import core
 
 
-def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="jaxlint",
-        description="JAX-aware static analysis: host/device aliasing, "
-                    "hidden syncs, recompile hazards, tracer leaks, "
-                    "PRNG misuse.")
-    ap.add_argument("paths", nargs="*", default=["ipex_llm_tpu"],
-                    help="files or directories to lint "
-                         "(default: ipex_llm_tpu)")
-    ap.add_argument("--json", action="store_true",
-                    help="machine-readable report (stable schema v%d)"
-                         % core.SCHEMA_VERSION)
-    ap.add_argument("--show-suppressed", action="store_true",
-                    help="include suppressed findings in human output")
-    ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule catalog and exit")
-    args = ap.parse_args(argv)
-
-    if args.list_rules:
-        for rule in sorted(core.all_rules().values(), key=lambda r: r.code):
-            print(f"{rule.code}  {rule.name:<22} [{rule.severity:<5}] "
-                  f"{rule.doc}")
-        return 0
-
+def _run_ast(args) -> int:
     # a typo'd path (or running from the wrong cwd) must not pass the
     # gate green by linting zero files
     missing = [p for p in args.paths if not Path(p).exists()]
@@ -53,6 +37,77 @@ def main(argv: list[str] | None = None) -> int:
     else:
         core.render_human(findings, show_suppressed=args.show_suppressed)
     return core.exit_code(findings)
+
+
+def _run_trace(args) -> int:
+    from ipex_llm_tpu.analysis.trace import runner
+
+    if args.list_programs:
+        runner.list_programs()
+        return 0
+    findings = runner.audit(manifest_path=args.manifest,
+                            update=args.update)
+    if args.json:
+        print(core.to_json(findings))
+    else:
+        core.render_human(findings, show_suppressed=args.show_suppressed,
+                          prog="jaxprcheck")
+        if args.update:
+            print("jaxprcheck: manifest written")
+    return core.exit_code(findings)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="JAX-aware static analysis.  Default: AST rules "
+                    "(aliasing, syncs, recompiles, tracer leaks, PRNG, "
+                    "donation).  --trace: abstract-trace the hot-path "
+                    "jitted programs and gate donation maps, fp8 "
+                    "integrity, callbacks, the recompile surface, and the "
+                    "per-tick dispatch count against a locked manifest.")
+    ap.add_argument("paths", nargs="*", default=["ipex_llm_tpu"],
+                    help="files or directories to lint "
+                         "(AST tier; default: ipex_llm_tpu)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report (stable schema v%d; "
+                         "findings carry tier='ast'|'trace')"
+                         % core.SCHEMA_VERSION)
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in human output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog (both tiers) and exit")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the trace tier (jaxprcheck) over the "
+                         "program registry instead of AST rules")
+    ap.add_argument("--update", action="store_true",
+                    help="(--trace) regenerate analysis/programs.lock.json "
+                         "from the current tree instead of diffing it")
+    ap.add_argument("--manifest", default=None, metavar="PATH",
+                    help="(--trace) manifest path override")
+    ap.add_argument("--list-programs", action="store_true",
+                    help="(--trace) print the program registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(core.all_rules().values(), key=lambda r: r.code):
+            print(f"{rule.code}  {rule.name:<22} [{rule.severity:<5}] "
+                  f"{rule.doc}")
+        return 0
+
+    if not args.trace and (args.update or args.list_programs
+                           or args.manifest):
+        print("jaxlint: --update/--manifest/--list-programs need --trace",
+              file=sys.stderr)
+        return 2
+
+    try:
+        return _run_trace(args) if args.trace else _run_ast(args)
+    except Exception:
+        # the analyzer itself failed — distinct from "findings" so CI can
+        # page on a broken gate instead of blaming the tree
+        traceback.print_exc()
+        return 3
 
 
 if __name__ == "__main__":

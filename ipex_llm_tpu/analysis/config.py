@@ -72,6 +72,23 @@ class Config:
         "_round_up", "round_up", "_bucket", "bucket", "next_pow2",
         "pad_batch", "pad_to",
     })
+    # JL007: modules whose jitted entries carry persistent device buffers
+    # across calls — a wrapper there that donates nothing doubles peak HBM
+    # for its cache/pool args (the trace tier, JP101, checks the actual
+    # lowered aliases; this is the cheap AST companion)
+    donation_modules: tuple[str, ...] = (
+        "ipex_llm_tpu/serving/*",
+        "ipex_llm_tpu/generation.py",
+        "ipex_llm_tpu/speculative.py",
+        "ipex_llm_tpu/structured.py",
+        "ipex_llm_tpu/transformers/multimodal.py",
+        "ipex_llm_tpu/parallel/pipeline.py",
+    )
+    # parameter names that mark a large persistent device buffer (JL007)
+    donation_hint_params: frozenset = frozenset({
+        "cache", "draft_cache", "row_cache", "kv", "kv_cache", "pool",
+        "prev_ring", "prev", "ring", "carry",
+    })
 
     def severity_for(self, key: str, rule: str, default: str) -> str:
         for pat, r, sev in self.severity_overrides:
@@ -84,6 +101,9 @@ class Config:
 
     def in_hot(self, key: str) -> bool:
         return match(key, self.hot_modules)
+
+    def in_donation(self, key: str) -> bool:
+        return match(key, self.donation_modules)
 
 
 DEFAULT_CONFIG = Config()

@@ -42,6 +42,10 @@ class Finding:
     message: str
     suppressed: bool = False
     reason: str | None = None    # suppression reason, when suppressed
+    # which analyzer produced it: "ast" (jaxlint source rules) or "trace"
+    # (jaxprcheck program audit).  Additive schema-v1 field: consumers that
+    # predate the trace tier ignore it.
+    tier: str = "ast"
 
     def render(self) -> str:
         sup = f"  [suppressed: {self.reason}]" if self.suppressed else ""
@@ -302,15 +306,19 @@ def to_json(findings: list[Finding]) -> str:
 
 
 def render_human(findings: list[Finding], show_suppressed: bool = False,
-                 out=sys.stdout) -> None:
+                 out=sys.stdout, prog: str = "jaxlint") -> None:
     shown = [f for f in findings if show_suppressed or not f.suppressed]
     for f in shown:
         print(f.render(), file=out)
     c = counts(findings)
-    print(f"jaxlint: {c['errors']} error(s), {c['warnings']} warning(s), "
+    print(f"{prog}: {c['errors']} error(s), {c['warnings']} warning(s), "
           f"{c['suppressed']} suppressed", file=out)
 
 
 def exit_code(findings: list[Finding]) -> int:
-    """0 = clean (warnings allowed), 1 = unsuppressed error-tier findings."""
+    """0 = clean (warnings allowed), 1 = unsuppressed error-tier findings.
+
+    The CLI adds 2 = usage error and 3 = internal analyzer error (the
+    analyzer itself crashed — NOT a statement about the tree), so CI can
+    distinguish "the gate failed" from "the gate is broken"."""
     return 1 if counts(findings)["errors"] else 0

@@ -10,12 +10,21 @@ Rule catalog (docs/quickstart/static_analysis.md has the long form):
 - JL004 tracer-leak       side effects escaping traced code
 - JL005 nondeterminism    wall-clock / host RNG / set-order in traced code
 - JL006 prng-key-reuse    one PRNG key consumed twice without split/fold_in
+- JL007 missing-donation  hot-path jit wrapper with cache/pool args and no
+                          donate_argnums (the AST companion to JP101)
+
+The trace tier's rules (JP100-JP106, ``analysis/trace/``) are registered
+here as catalog stubs so ``--list-rules`` shows the full inventory and
+suppression comments naming JP codes validate; their checks run in the
+jaxprcheck runner, not per source file.
 """
 
 from ipex_llm_tpu.analysis.core import register
+from ipex_llm_tpu.analysis.trace.catalog import TRACE_RULES
 
 from ipex_llm_tpu.analysis.rules import (  # noqa: F401  (register on import)
     aliasing,
+    donation,
     hostsync,
     nondeterminism,
     prng,
@@ -31,3 +40,15 @@ def _jl000(ctx, config):
     # emitted by core.parse_suppressions, never by a rule body; registered
     # so the code renders in --list-rules and "disable=JL000" resolves
     return iter(())
+
+
+def _register_trace_stubs():
+    for code, (name, severity, doc) in TRACE_RULES.items():
+        @register(code, name, severity, doc)
+        def _stub(ctx, config):
+            # trace rules audit lowered programs, not source files: the
+            # jaxprcheck runner (analysis/trace/runner.py) executes them
+            return iter(())
+
+
+_register_trace_stubs()

@@ -1,0 +1,75 @@
+"""JL007 missing-donation: a hot-path jit wrapper that donates nothing.
+
+The cheap AST companion to the trace-level JP101 gate: a ``jax.jit``
+wrapper in a hot-path module whose signature takes a large persistent
+buffer (a KV cache, pool, or sampling ring — recognized by parameter
+name) but carries neither ``donate_argnums`` nor ``donate_argnames``
+forces XLA to keep input AND output copies live — for a KV pool that is
+the whole pool twice, the classic silent peak-HBM doubling.
+
+Warn tier: parameter names are a heuristic (the trace tier proves the
+actual aliasing).  The rule goes quiet as soon as the wrapper donates
+*anything* — which arguments should alias is JP101's job.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ipex_llm_tpu.analysis import astutil
+from ipex_llm_tpu.analysis.core import WARN, register
+
+_DONATE_KEYWORDS = {"donate_argnums", "donate_argnames"}
+
+
+def _donates(expr: ast.AST) -> bool:
+    """Any donate_* keyword anywhere in the decorator/value expression
+    (covers ``jax.jit(..., donate_argnums=...)`` and both partial
+    spellings)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            if any(k.arg in _DONATE_KEYWORDS for k in node.keywords):
+                return True
+    return False
+
+
+def _param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    a = fn.args
+    return {p.arg for p in
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+
+
+@register("JL007", "missing-donation", WARN,
+          "hot-path jax.jit wrapper takes large persistent-buffer args "
+          "(cache/pool/ring) but donates nothing")
+def check(ctx, config):
+    if not config.in_donation(ctx.key):
+        return
+    defs = {n.name: n for n in ctx.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ctx.tree.body:
+        fn, jit_expr = None, None
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if astutil.is_jit_expr(dec, ctx.aliases):
+                    fn, jit_expr = node, dec
+                    break
+        elif isinstance(node, ast.Assign) and astutil.is_jit_expr(
+                node.value, ctx.aliases) and isinstance(
+                    node.value, ast.Call):
+            # g = jax.jit(impl, ...): resolve impl if defined in-module
+            inner = node.value.args[0] if node.value.args else None
+            if isinstance(inner, ast.Name) and inner.id in defs:
+                fn, jit_expr = defs[inner.id], node.value
+        if fn is None:
+            continue
+        hints = _param_names(fn) & config.donation_hint_params
+        if hints and not _donates(jit_expr):
+            yield ctx.finding(
+                "JL007", WARN, fn,
+                f"jitted '{fn.name}' takes persistent-buffer arg(s) "
+                f"{sorted(hints)} but the jit wrapper has no donate_"
+                "argnums/donate_argnames — input and output buffers both "
+                "stay live (peak-HBM doubles for a KV pool); donate the "
+                "dead-after-call inputs (trace rule JP101 verifies the "
+                "aliases actually survive lowering)")

@@ -1,0 +1,32 @@
+"""jaxprcheck — trace-level program audit (the second static-analysis tier).
+
+jaxlint (``analysis/rules/``) machine-checks hazards at the AST level; it
+cannot see what XLA actually compiles.  This package abstract-traces the
+repo's hot-path jitted programs (``jax.jit(...).trace(...).lower()`` on
+CPU ShapeDtypeStructs — no execution, no real weights) and gates their
+compiled-program properties:
+
+- JP101 donation-coverage: large dead-after-call inputs must appear in
+  the lowered ``input_output_aliases``; donated-but-held buffers flagged;
+- JP102 fp8-pool dtype integrity: e5m2 pool avals stay e5m2 end to end
+  (PR 5's dequant-at-read contract, machine-checked);
+- JP103 host-callback freedom in the lowered hot programs;
+- JP104 recompile-surface: the lowering count over the enumerated bucket
+  grid is bounded and matches the manifest;
+- JP105 constant-bloat: closure-captured constants baked into the jaxpr;
+- JP106 tick-dispatch-count: a mixed prefill+decode tick issues at most
+  2 device dispatches (the gate ROADMAP item 1 tightens to 1).
+
+The audited inventory is locked in ``analysis/programs.lock.json``; drift
+fails CI with a readable diff and ``scripts/jaxprcheck --update``
+regenerates it.  Submodules that need jax (`registry`, `tracer`, `rules`,
+`runner`) are imported lazily so the AST tier stays jax-free.
+"""
+
+from ipex_llm_tpu.analysis.trace.catalog import TRACE_RULES  # noqa: F401
+from ipex_llm_tpu.analysis.trace.tickaudit import (  # noqa: F401
+    TickSpec,
+    discover_tick_dispatches,
+    mixed_tick_dispatch_count,
+    mixed_tick_spec,
+)

@@ -1,0 +1,121 @@
+"""programs.lock.json: the locked inventory of hot-path compiled programs.
+
+Per program, per grid point: the donation map (which input leaves alias
+which outputs), input/output aval summaries, ``cost_analysis`` flops and
+bytes-accessed, callback/constant facts; per program: the distinct
+lowering count; plus the tick dispatch chains.  ``--update`` regenerates
+the file; on a clean tree that is a no-op (everything serialized here is
+a deterministic function of the registry and the pinned CPU backend).
+Any drift between the built inventory and the checked-in file fails CI
+with a readable path-by-path diff.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SCHEMA = 1
+
+DEFAULT_PATH = Path(__file__).resolve().parent.parent / "programs.lock.json"
+
+
+def _leaf_summary(leaves) -> dict:
+    """arg name -> compact aval summary (single leaf: the aval; pytrees:
+    leaf count + total bytes — stable, diff-friendly)."""
+    by_arg: dict[str, list] = {}
+    for leaf in leaves:
+        by_arg.setdefault(leaf.arg, []).append(leaf)
+    out = {}
+    for arg, ls in by_arg.items():
+        if len(ls) == 1:
+            out[arg] = f"{ls[0].dtype}{list(ls[0].shape)}"
+        else:
+            out[arg] = (f"pytree({len(ls)} leaves, "
+                        f"{sum(l.nbytes for l in ls)}B)")
+    return out
+
+
+def entry_record(entry) -> dict:
+    return {
+        "aliases": {l.label: l.alias for l in entry.leaves
+                    if l.alias is not None},
+        "donated": sorted(l.label for l in entry.leaves if l.donated),
+        "inputs": _leaf_summary(entry.leaves),
+        "outputs": [f"{d}{list(s)}" for s, d in entry.out_avals],
+        "flops": entry.flops,
+        "bytes_accessed": entry.bytes_accessed,
+        "const_bytes": entry.const_bytes,
+        "callbacks": list(entry.callbacks),
+    }
+
+
+def build(program_results: list, tick_results: list) -> dict:
+    """``program_results``: (spec, entries|None, skip_reason|None);
+    ``tick_results``: (tick_spec, effective_dispatch_set)."""
+    programs = {}
+    for spec, entries, skipped in program_results:
+        rec: dict = {"source": spec.source}
+        if skipped:
+            rec["skipped"] = skipped
+        else:
+            rec["lowerings"] = len(entries)
+            rec["entries"] = {e.point_key: entry_record(e) for e in entries}
+        programs[spec.name] = rec
+    ticks = {
+        t.name: {"programs": sorted(dispatches),
+                 "dispatches": len(dispatches),
+                 "max_dispatches": t.max_dispatches}
+        for t, dispatches in tick_results
+    }
+    return {"schema": SCHEMA, "backend": "cpu",
+            "programs": programs, "ticks": ticks}
+
+
+def save(manifest: dict, path: Path | str = DEFAULT_PATH):
+    Path(path).write_text(json.dumps(manifest, indent=2, sort_keys=True)
+                          + "\n", encoding="utf-8")
+
+
+def load(path: Path | str = DEFAULT_PATH) -> dict | None:
+    p = Path(path)
+    if not p.exists():
+        return None
+    return json.loads(p.read_text(encoding="utf-8"))
+
+
+def diff(locked: dict, built: dict, prefix: str = "",
+         limit: int = 60, ignore_keys: tuple[str, ...] = ()) -> list[str]:
+    """Readable path-by-path differences (locked -> built).
+
+    ``ignore_keys``: dict keys whose value changes are reported elsewhere
+    (the runner passes "lowerings" — count drift is JP104's finding, and
+    double-reporting it here would cost a second suppression per known
+    drift)."""
+    lines: list[str] = []
+    _diff_into(locked, built, prefix, lines, ignore_keys)
+    if len(lines) > limit:
+        lines = lines[:limit] + [f"... {len(lines) - limit} more"]
+    return lines
+
+
+def _diff_into(a, b, prefix: str, out: list[str],
+               ignore_keys: tuple[str, ...] = ()):
+    if isinstance(a, dict) and isinstance(b, dict):
+        for k in sorted(set(a) | set(b)):
+            if k in ignore_keys and k in a and k in b:
+                continue
+            p = f"{prefix}.{k}" if prefix else str(k)
+            if k not in a:
+                out.append(f"+ {p} = {_short(b[k])}")
+            elif k not in b:
+                out.append(f"- {p} (was {_short(a[k])})")
+            else:
+                _diff_into(a[k], b[k], p, out, ignore_keys)
+    elif a != b:
+        out.append(f"~ {prefix}: {_short(a)} -> {_short(b)}")
+
+
+def _short(v) -> str:
+    s = json.dumps(v, sort_keys=True, default=str)
+    return s if len(s) <= 80 else s[:77] + "..."

@@ -1,0 +1,403 @@
+"""The program registry: every hot-path jitted entry, with its abstract-
+input builder over the real bucket grid and its expected contracts.
+
+A ProgramSpec names the jitted callable, how to build abstract inputs for
+one grid point (ShapeDtypeStructs over a tiny audit model — lowering cost
+is shape-independent-enough that tiny dims keep the audit fast while the
+*grid* axes stay the engine's real ones: prefill buckets x horizons x row
+counts x kv_storage in {bf16, fp8}), and the donation contract: which
+dynamic args are DEAD after the call (the host overwrites its handle —
+donation candidates, JP101 demands aliases for the large ones) and which
+are HELD (the host re-passes the same buffer next call — donation there
+is a use-after-donate bug, also JP101).
+
+Registering a new program (docs/quickstart/static_analysis.md has the
+worked example): write a builder returning the exact ``(args, kwargs)``
+the real call site passes (statics included), list the dynamic arg names
+in signature order, declare dead/held, pick the grid, append the spec in
+``real_registry``, then run ``scripts/jaxprcheck --update`` and commit
+the manifest diff.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.analysis.config import relkey
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    name: str
+    fn: Any                                   # the jitted callable
+    build: Callable[[dict], tuple[tuple, dict]]
+    grid: tuple[dict, ...]
+    arg_names: tuple[str, ...]                # dynamic args, in order
+    dead: frozenset = frozenset()             # dead-after-call arg names
+    held: frozenset = frozenset()             # host-reused arg names
+    min_donate_bytes: int = 2048              # JP101 floor at audit shapes
+    max_lowerings: int = 8                    # JP104 bound
+    const_bytes_limit: int = 1 << 16          # JP105 threshold
+    suppress: tuple[tuple[str, str], ...] = ()   # (code, written reason)
+    requires: str | None = None               # e.g. "jax.shard_map"
+    source: str = field(default="", compare=False)
+    lineno: int = field(default=1, compare=False)
+
+    def __post_init__(self):
+        if not self.source:
+            import inspect
+
+            fn = inspect.unwrap(self.fn)
+            wrapped = getattr(fn, "__wrapped__", fn)
+            object.__setattr__(self, "source",
+                               relkey(inspect.getsourcefile(wrapped)))
+            object.__setattr__(self, "lineno",
+                               wrapped.__code__.co_firstlineno)
+
+
+def requirement_met(requires: str | None) -> bool:
+    """'jax.shard_map'-style dotted attribute probe."""
+    if not requires:
+        return True
+    obj: Any = __import__(requires.split(".", 1)[0])
+    for part in requires.split(".")[1:]:
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return False
+    return True
+
+
+# --------------------------------------------------------------------------
+# the audit model: tiny dims, real param-tree structure
+# --------------------------------------------------------------------------
+
+def _sds(tree):
+    return jax.tree_util.tree_map(
+        lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                   if hasattr(x, "shape") else x), tree)
+
+
+@lru_cache(maxsize=1)
+def audit_model():
+    """(cfg, abstract params) for a tiny llama through the REAL build
+    path, so the param tree the audit lowers against is structurally the
+    tree every engine entry actually takes."""
+    from ipex_llm_tpu.models.random_init import llama_config, random_params
+
+    cfg = llama_config(hidden_size=32, intermediate_size=64, num_layers=2,
+                       num_heads=4, num_kv_heads=2, vocab_size=97,
+                       max_position_embeddings=256)
+    return cfg, _sds(random_params(cfg, qtype="bf16", seed=0))
+
+
+_POOL_PAGES = 18      # audit pool: pages, page size, table width
+_PAGE = 16
+_MAXP = 4
+
+
+def _paged_cache(rows: int, storage: str, max_pages: int = _MAXP):
+    from ipex_llm_tpu.kv import PagedKVCache
+
+    cfg, _ = audit_model()
+    return _sds(PagedKVCache.init(
+        cfg.num_layers, _POOL_PAGES, rows, max_pages, cfg.num_kv_heads,
+        _PAGE, cfg.head_dim, v_head_dim=cfg.v_dim, storage=storage))
+
+
+def _dense_cache(batch: int, capacity: int):
+    from ipex_llm_tpu.kv import make_cache
+
+    cfg, _ = audit_model()
+    return _sds(make_cache("normal", cfg.num_layers, batch, capacity,
+                           cfg.num_kv_heads, cfg.head_dim,
+                           v_head_dim=cfg.v_dim))
+
+
+def _key():
+    return jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+
+def _i32(*s):
+    return jax.ShapeDtypeStruct(s, jnp.int32)
+
+
+def _f32(*s):
+    return jax.ShapeDtypeStruct(s, jnp.float32)
+
+
+def _bool(*s):
+    return jax.ShapeDtypeStruct(s, jnp.bool_)
+
+
+def _grid(**axes) -> tuple[dict, ...]:
+    """Cartesian product of named axes, insertion-ordered."""
+    points: list[dict] = [{}]
+    for name, values in axes.items():
+        points = [{**p, name: v} for p in points for v in values]
+    return tuple(points)
+
+
+# --------------------------------------------------------------------------
+# builders — one per registered program, mirroring the real call sites
+# --------------------------------------------------------------------------
+
+def _build_decode_multi_step(pt):
+    cfg, params = audit_model()
+    r = pt["rows"]
+    return (cfg, params, _paged_cache(r, pt["kv"]), _i32(r), _i32(r),
+            _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r), _i32(r),
+            _i32(r, 2), _i32(r)), {"horizon": pt["horizon"], "mesh": None}
+
+
+def _build_mixed_prefill(pt):
+    cfg, params = audit_model()
+    p = 2   # pow2-padded prefilling-row batch
+    return (cfg, params, _paged_cache(p, pt["kv"], max_pages=2),
+            _i32(p, pt["width"]), _i32(p), _i32(p), _bool(p), _f32(p),
+            _f32(p), _key(), _i32(p), _i32(p)), {"mesh": None}
+
+
+def _build_prefill_chunk(pt):
+    cfg, params = audit_model()
+    return (cfg, params, _paged_cache(4, pt["kv"]), _i32(1, pt["bucket"]),
+            _i32(1, _MAXP), _i32(), _i32()), {"mesh": None}
+
+
+def _build_verify_step(pt):
+    cfg, params = audit_model()
+    r, k = 4, 3
+    return (cfg, params, _paged_cache(r, pt["kv"]), _i32(r), _i32(r, k),
+            _i32(r), _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r),
+            _i32(r)), {"k": k, "mesh": None}
+
+
+def _pp_mesh():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("tp", "pp"))
+
+
+def _build_pp_decode_sample(pt):
+    cfg, params = audit_model()
+    r = 4
+    return (cfg, params, _paged_cache(r, "bf16"), _i32(r), _i32(r),
+            _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r),
+            _i32(r)), {"mesh": _pp_mesh(), "n_micro": 2}
+
+
+def _build_pp_verify_step(pt):
+    cfg, params = audit_model()
+    r, k = 4, 3
+    return (cfg, params, _paged_cache(r, "bf16"), _i32(r), _i32(r, k),
+            _i32(r), _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r),
+            _i32(r)), {"k": k, "mesh": _pp_mesh(), "n_micro": 2}
+
+
+def _build_gen_prefill(pt):
+    cfg, params = audit_model()
+    b = pt["batch"]
+    return (cfg, params, _dense_cache(b, pt["bucket"] + 32),
+            _i32(b, pt["bucket"]), _i32(b)), {}
+
+
+def _gen_config():
+    from ipex_llm_tpu.generation import GenerationConfig
+
+    return GenerationConfig(max_new_tokens=32, eos_token_id=(1,))
+
+
+def _build_decode_loop(pt):
+    cfg, params = audit_model()
+    b = pt["batch"]
+    return (cfg, params, _dense_cache(b, 160), _i32(b), _i32(b), _i32(b),
+            _i32(b, 512), _key(), _gen_config(), 32), {}
+
+
+def _build_decode_one(pt):
+    cfg, params = audit_model()
+    b = pt["batch"]
+    return (cfg, params, _dense_cache(b, 160), _i32(b), _i32(b), _i32(b),
+            _i32(b, 512), _i32(b), _key(), _gen_config()), {}
+
+
+def _build_mm_prefill(pt):
+    cfg, params = audit_model()
+    t = pt["bucket"]
+    return (cfg, params, _dense_cache(1, t + 32), _i32(1, t), _i32(1, t),
+            _f32(1, t, cfg.hidden_size)), {}
+
+
+def _build_mm_decode(pt):
+    cfg, params = audit_model()
+    return (cfg, params, _dense_cache(1, 96), _i32(1, 1), _i32(1, 1)), {}
+
+
+def _build_json_decode_step(pt):
+    cfg, params = audit_model()
+    return (cfg, params, _dense_cache(1, 96), _i32(1, 1), _i32(1, 1),
+            _i32(1)), {}
+
+
+# --------------------------------------------------------------------------
+# the registry
+# --------------------------------------------------------------------------
+
+@lru_cache(maxsize=1)
+def real_registry() -> tuple[ProgramSpec, ...]:
+    from ipex_llm_tpu import generation, structured
+    from ipex_llm_tpu.serving import engine
+    from ipex_llm_tpu.transformers import multimodal
+
+    kv_axis = ("bf16", "fp8")
+    return (
+        # -- serving/engine.py ------------------------------------------
+        ProgramSpec(
+            name="serving.decode_multi_step",
+            fn=engine._decode_multi_step,
+            build=_build_decode_multi_step,
+            grid=_grid(rows=(4, 8), horizon=(1, 8), kv=kv_axis),
+            arg_names=("params", "cache", "toks", "row_lens", "active",
+                       "temps", "top_ps", "key", "seeds", "steps",
+                       "top_ks", "eos", "remain"),
+            dead=frozenset({"cache", "toks", "row_lens", "active",
+                            "steps", "remain"}),
+            # key is HELD, not dead: the engine's _checkpoint snapshots
+            # self.key by reference for bit-identical transient retry —
+            # donating it would let a rollback restore a deleted buffer
+            held=frozenset({"params", "temps", "top_ps", "seeds", "top_ks",
+                            "eos", "key"}),
+            max_lowerings=8,
+        ),
+        ProgramSpec(
+            name="serving.mixed_prefill",
+            fn=engine._mixed_prefill_fn,
+            build=_build_mixed_prefill,
+            grid=_grid(width=(8, 128), kv=kv_axis),
+            arg_names=("params", "cache", "tokens", "base_lens", "n_valid",
+                       "emit", "temps", "top_ps", "key", "seeds", "top_ks"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params", "key"}),   # key: checkpoint-held
+            max_lowerings=4,
+        ),
+        ProgramSpec(
+            name="serving.prefill_chunk",
+            fn=engine._prefill_chunk,
+            build=_build_prefill_chunk,
+            grid=_grid(bucket=(128,), kv=kv_axis),
+            arg_names=("params", "cache", "tokens", "table_row", "base_len",
+                       "n_valid"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params"}),
+            max_lowerings=2,
+        ),
+        ProgramSpec(
+            name="serving.verify_step",
+            fn=engine._verify_step,
+            build=_build_verify_step,
+            grid=_grid(kv=kv_axis),
+            arg_names=("params", "cache", "toks", "drafts", "row_lens",
+                       "active", "temps", "top_ps", "key", "seeds", "steps",
+                       "top_ks"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params", "temps", "top_ps", "seeds",
+                            "top_ks", "key"}),   # key: checkpoint-held
+            max_lowerings=2,
+        ),
+        ProgramSpec(
+            name="serving.pp_decode_sample",
+            fn=engine._pp_decode_sample,
+            build=_build_pp_decode_sample,
+            grid=_grid(kv=("bf16",)),
+            arg_names=("params", "cache", "toks", "row_lens", "active",
+                       "temps", "top_ps", "key", "seeds", "steps",
+                       "top_ks"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params", "key"}),   # key: checkpoint-held
+            max_lowerings=1,
+            requires="jax.shard_map",
+        ),
+        ProgramSpec(
+            name="serving.pp_verify_step",
+            fn=engine._pp_verify_step,
+            build=_build_pp_verify_step,
+            grid=_grid(kv=("bf16",)),
+            arg_names=("params", "cache", "toks", "drafts", "row_lens",
+                       "active", "temps", "top_ps", "key", "seeds", "steps",
+                       "top_ks"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params", "key"}),   # key: checkpoint-held
+            max_lowerings=1,
+            requires="jax.shard_map",
+        ),
+        # -- generation.py ----------------------------------------------
+        ProgramSpec(
+            name="generation.prefill_step",
+            fn=generation.prefill_step,
+            build=_build_gen_prefill,
+            grid=_grid(batch=(1, 2), bucket=(128,)),
+            arg_names=("params", "cache", "tokens", "lengths"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params"}),
+            max_lowerings=2,
+        ),
+        ProgramSpec(
+            name="generation.decode_loop",
+            fn=generation.decode_loop,
+            build=_build_decode_loop,
+            grid=_grid(batch=(2,)),
+            arg_names=("params", "cache", "first_tokens", "lengths",
+                       "kv_start", "prev_ring", "key"),
+            dead=frozenset({"cache", "first_tokens", "prev_ring", "key"}),
+            held=frozenset({"params"}),
+            max_lowerings=1,
+        ),
+        ProgramSpec(
+            name="generation.decode_one",
+            fn=generation._decode_one,
+            build=_build_decode_one,
+            grid=_grid(batch=(2,)),
+            arg_names=("params", "cache", "tok", "pos", "kv_start", "prev",
+                       "ring_idx", "key"),
+            dead=frozenset({"cache", "tok", "prev", "key"}),
+            held=frozenset({"params"}),
+            max_lowerings=1,
+        ),
+        # -- transformers/multimodal.py ---------------------------------
+        ProgramSpec(
+            name="multimodal.mm_prefill",
+            fn=multimodal._mm_prefill,
+            build=_build_mm_prefill,
+            grid=_grid(bucket=(64,)),
+            arg_names=("params", "cache", "tokens", "pos", "embeds"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params"}),
+            max_lowerings=1,
+        ),
+        ProgramSpec(
+            name="multimodal.mm_decode",
+            fn=multimodal._mm_decode,
+            build=_build_mm_decode,
+            grid=_grid(bucket=(1,)),
+            arg_names=("params", "cache", "tok", "pos"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params"}),
+            max_lowerings=1,
+        ),
+        # -- structured.py ----------------------------------------------
+        ProgramSpec(
+            name="structured.json_decode_step",
+            fn=structured._json_decode_step,
+            build=_build_json_decode_step,
+            grid=_grid(bucket=(1,)),
+            arg_names=("params", "cache", "tok", "pos", "kv_start"),
+            dead=frozenset({"cache"}),
+            held=frozenset({"params"}),
+            max_lowerings=1,
+        ),
+    )

@@ -1,0 +1,171 @@
+"""JP101-JP106: rules over lowered programs (tracer.TracedEntry facts).
+
+Each rule yields ``core.Finding`` objects with ``tier="trace"``, anchored
+at the jitted function's def site so findings are clickable.  Spec-level
+suppressions (``ProgramSpec.suppress``) are applied by the runner, under
+the same loud policy as jaxlint: a suppression without a written reason
+is itself a JP100 error.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ipex_llm_tpu.analysis.core import Finding
+from ipex_llm_tpu.analysis.trace.catalog import severity_of
+from ipex_llm_tpu.analysis.trace.tracer import TracedEntry
+
+# dtypes a pool upcast would land in (JP102)
+_WIDE_FLOATS = {"float32", "bfloat16", "float16", "float64"}
+_FP8 = ("float8_e5m2", "float8_e4m3")
+
+
+def finding(spec, code: str, message: str, at: str = "") -> Finding:
+    where = f" @ {at}" if at else ""
+    return Finding(rule=code, severity=severity_of(code), path=spec.source,
+                   line=spec.lineno, col=1, tier="trace",
+                   message=f"[{spec.name}{where}] {message}")
+
+
+def check_donation(spec, entry: TracedEntry):
+    """JP101: every large dead-after-call input with a matching output
+    aval must hold a lowered alias; a donated-but-held buffer is a
+    use-after-donate hazard either way."""
+    # outputs not already consumed by a surviving alias are the slots a
+    # missing donation wastes (matched by aval: XLA aliases exact
+    # shape+dtype pairs only)
+    free_outs = Counter(entry.out_avals)
+    for leaf in entry.leaves:
+        if leaf.alias is not None and leaf.alias < len(entry.out_avals):
+            free_outs[entry.out_avals[leaf.alias]] -= 1
+    for leaf in entry.leaves:
+        if leaf.arg in spec.held and leaf.alias is not None:
+            yield finding(
+                spec, "JP101",
+                f"host-held input {leaf.label} ({leaf.dtype}"
+                f"{list(leaf.shape)}) is donated: the host keeps using "
+                "this buffer across calls — donation here is a "
+                "use-after-donate time bomb", entry.point_key)
+            continue
+        if leaf.arg not in spec.dead or leaf.alias is not None:
+            continue
+        if leaf.nbytes < spec.min_donate_bytes:
+            continue
+        sig = (leaf.shape, leaf.dtype)
+        if leaf.donated:
+            yield finding(
+                spec, "JP101",
+                f"donation of {leaf.label} ({leaf.dtype}{list(leaf.shape)}, "
+                f"{leaf.nbytes}B) was requested but survived lowering with "
+                "no alias — shape/dtype matches no output, so the donated "
+                "buffer is silently copied anyway", entry.point_key)
+        elif free_outs.get(sig, 0) > 0:
+            free_outs[sig] -= 1
+            yield finding(
+                spec, "JP101",
+                f"dead-after-call input {leaf.label} ({leaf.dtype}"
+                f"{list(leaf.shape)}, {leaf.nbytes}B) has a matching "
+                "output aval but no input_output_alias — the buffer is "
+                "re-uploaded/copied every call; add it to donate_argnums",
+                entry.point_key)
+
+
+def check_fp8_integrity(spec, entry: TracedEntry):
+    """JP102: pool-resident e5m2 avals stay e5m2 end to end.  Protected
+    shapes are the fp8 input avals (the pool and its per-layer slices);
+    any value of a protected shape materializing in a wide float dtype is
+    a wholesale upcast — the dequant-at-read contract says only *gathered
+    tiles* (different shapes) ever widen."""
+    protected: set[tuple[int, ...]] = set()
+    for leaf in entry.leaves:
+        if leaf.dtype.startswith(_FP8) and len(leaf.shape) >= 3:
+            protected.add(leaf.shape)
+            protected.add(leaf.shape[1:])           # per-layer slice
+            protected.add((1,) + leaf.shape[1:])    # dynamic_slice form
+    if not protected:
+        return
+    seen: set[tuple[tuple[int, ...], str]] = set()
+    for shape, dtype in entry.eqn_avals + entry.out_avals:
+        if shape in protected and dtype in _WIDE_FLOATS \
+                and (shape, dtype) not in seen:
+            seen.add((shape, dtype))
+            yield finding(
+                spec, "JP102",
+                f"pool-shaped value {dtype}{list(shape)} materializes "
+                "inside the lowered program — a wholesale upcast of the "
+                "e5m2 pool (2x the bytes the fp8 contract paid for); "
+                "widen gathered tiles at the read site instead",
+                entry.point_key)
+
+
+def check_callbacks(spec, entry: TracedEntry):
+    """JP103: hot programs must be host-callback-free."""
+    if entry.callbacks:
+        yield finding(
+            spec, "JP103",
+            f"host callback primitive(s) {list(entry.callbacks)} in the "
+            "lowered program — each one stalls the device on a host round "
+            "trip; move the logic out of the jitted hot path",
+            entry.point_key)
+
+
+def check_recompile_surface(spec, n_lowerings: int,
+                            manifest_count: int | None):
+    """JP104: the grid's distinct-lowering count is bounded and matches
+    the locked manifest (the trace-level teeth behind AST rule JL003)."""
+    if n_lowerings > spec.max_lowerings:
+        yield finding(
+            spec, "JP104",
+            f"the enumerated grid produces {n_lowerings} distinct "
+            f"lowerings, above the spec bound {spec.max_lowerings} — an "
+            "axis leaked into the trace key; bucket it or raise the bound "
+            "deliberately")
+    if manifest_count is not None and n_lowerings != manifest_count:
+        yield finding(
+            spec, "JP104",
+            f"distinct lowerings = {n_lowerings} but the manifest locks "
+            f"{manifest_count} — the compiled-program inventory drifted; "
+            "review and run scripts/jaxprcheck --update")
+
+
+def check_constant_bloat(spec, entry: TracedEntry):
+    """JP105: closure-captured constants baked into the jaxpr."""
+    if entry.const_bytes > spec.const_bytes_limit:
+        yield finding(
+            spec, "JP105",
+            f"{entry.const_bytes}B of closure-captured constants baked "
+            f"into the jaxpr (limit {spec.const_bytes_limit}B) — every "
+            "retrace re-uploads them; pass them as arguments instead",
+            entry.point_key)
+
+
+def check_tick_dispatches(tick, discovered: set[str]):
+    """JP106: the tick's reachable jitted-callee set equals the declared
+    program chain and stays within the dispatch gate."""
+    effective = discovered - set(tick.alternates)
+    declared = set(tick.programs)
+    if effective != declared:
+        extra = sorted(effective - declared)
+        gone = sorted(declared - effective)
+        parts = []
+        if extra:
+            parts.append(f"undeclared dispatch(es) {extra}")
+        if gone:
+            parts.append(f"declared program(s) {gone} no longer reachable")
+        yield _tick_finding(
+            tick, "JP106",
+            f"tick '{tick.name}' program set drifted: {'; '.join(parts)} — "
+            "update the TickSpec if this is intentional")
+    if len(effective) > tick.max_dispatches:
+        yield _tick_finding(
+            tick, "JP106",
+            f"tick '{tick.name}' can issue {len(effective)} device "
+            f"dispatches ({sorted(effective)}), above the gate of "
+            f"{tick.max_dispatches} — the mixed tick's dispatch budget is "
+            "a locked serving invariant")
+
+
+def _tick_finding(tick, code: str, message: str) -> Finding:
+    path = tick.module.replace(".", "/") + ".py"
+    return Finding(rule=code, severity=severity_of(code), path=path,
+                   line=1, col=1, tier="trace", message=message)

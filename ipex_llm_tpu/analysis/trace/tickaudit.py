@@ -1,0 +1,94 @@
+"""JP106 groundwork: static dispatch-count audit of an engine tick.
+
+One engine tick's device-dispatch count is THE quantity the ragged-paged-
+attention superkernel roadmap item must drive to one — so it is locked
+here, statically.  We cannot count dispatches of an abstract trace (no
+execution), but we can enumerate which module-level jitted entries a
+tick's scheduler functions can possibly call: the tick functions are
+plain host Python, so every device dispatch they issue is a call to a
+module-level jit-bound name, which plain AST walking finds exactly.
+
+Kept jax-free so benchmark/serving_bench.py can stamp the audited count
+into its output rows without paying a tracer import.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from importlib import import_module
+
+from ipex_llm_tpu.analysis import astutil
+
+
+@dataclass(frozen=True)
+class TickSpec:
+    """What one engine tick is allowed to dispatch.
+
+    ``entries`` are the scheduler functions that make up the tick (host
+    Python, searched by name anywhere in the module — methods included);
+    ``programs`` the jitted callees that ARE the tick's dispatch chain;
+    ``alternates`` jitted callees reachable from the same source but on a
+    different engine mode's path (they don't count against this tick).
+    """
+    name: str
+    module: str                       # import path of the engine module
+    entries: tuple[str, ...]
+    programs: tuple[str, ...]
+    alternates: tuple[str, ...] = ()
+    max_dispatches: int = 2
+    suppress: tuple[tuple[str, str], ...] = ()   # (code, reason)
+
+
+def mixed_tick_spec() -> TickSpec:
+    """The mixed prefill+decode tick: ONE batched ragged-chunk program
+    (``_mixed_prefill_fn``) chained with ONE fused decode program
+    (``_decode_multi_step``) — 2 dispatches today; ROADMAP item 1's
+    superkernel tightens this gate to 1."""
+    return TickSpec(
+        name="mixed",
+        module="ipex_llm_tpu.serving.engine",
+        entries=("_mixed_step", "_horizon_step"),
+        programs=("_mixed_prefill_fn", "_decode_multi_step"),
+        alternates=("_pp_decode_sample",),   # pp engines route H=1 here
+        max_dispatches=2,
+    )
+
+
+def _module_source(module: str) -> str:
+    import inspect
+
+    return inspect.getsource(import_module(module))
+
+
+def discover_tick_dispatches(spec: TickSpec,
+                             source: str | None = None) -> set[str]:
+    """Module-level jit-bound names callable from the tick's entry
+    functions (alternates included — the caller subtracts them)."""
+    src = source if source is not None else _module_source(spec.module)
+    tree = ast.parse(src)
+    aliases = astutil.import_aliases(tree)
+    jit_names = astutil.module_jit_names(tree, aliases)
+    found: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in spec.entries:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in jit_names:
+                found.add(name)
+    return found
+
+
+def mixed_tick_dispatch_count(source: str | None = None) -> int:
+    """Dispatches one mixed tick issues on the non-pp path — the number
+    serving_bench stamps into its rows so BENCH artifacts track it
+    against the JP106 gate."""
+    spec = mixed_tick_spec()
+    return len(discover_tick_dispatches(spec, source) - set(spec.alternates))

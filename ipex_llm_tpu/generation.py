@@ -430,7 +430,11 @@ def _generate_inner(cfg, params, gen, tokens, lengths, tpad, b, cache, mesh,
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
+# prev (the repetition-penalty ring) is dead after the call — the caller
+# rebinds it to the returned ring every step — and matches the ring output
+# aval exactly, so donating it aliases the buffers instead of copying
+# [B, REP_WINDOW] per token (trace audit JP101 on generation.decode_one)
+@partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2, 6))
 def _decode_one(cfg, params, cache, tok, pos, kv_start, prev, ring_idx, key,
                 gen: GenerationConfig, lengths=None, input_embeds=None):
     logits, cache = decoder_forward(

@@ -269,8 +269,19 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
     return keys
 
 
+# donation covers the cache AND every dead-after-call piece of the
+# device-resident row state (toks/row_lens/active/steps/remain): the host
+# rebinds its _dev handles to the returned arrays each call, so the
+# inputs alias their advanced outputs instead of being copied per tick.
+# temps/top_ps/seeds/top_ks/eos are HELD — the host re-passes the same
+# buffers until the next epoch upload — and must never be donated.  The
+# PRNG key is held too, less obviously: _checkpoint snapshots self.key BY
+# REFERENCE for the bit-identical transient-retry contract, so donating
+# it would hand _rollback a deleted buffer whenever a fault lands after
+# the dispatch (the d2h sync is exactly where async XLA faults surface).
+# The trace audit (JP101 in analysis/trace/) locks both directions.
 @partial(jax.jit, static_argnames=("cfg", "horizon", "mesh"),
-         donate_argnums=(2,))
+         donate_argnums=(2, 3, 4, 5, 10, 13))
 def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
                        active, temps, top_ps, key, seeds, steps, top_ks,
                        eos, remain, horizon: int = 1, mesh=None):

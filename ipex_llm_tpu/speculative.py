@@ -69,6 +69,13 @@ def _forward_at(cfg, params, cache, seq_buf, start, t: int, length):
     jax.jit,
     static_argnames=("cfg", "draft_cfg", "k", "max_new", "eos_ids", "ngram",
                      "sp", "adaptive"),
+    # seq_buf is dead after the call (the caller rebinds it to the returned
+    # buffer) and matches the output aval — donate it so the [1, S] window
+    # aliases instead of copying.  The caches are consumed on-device and
+    # never returned, so they have no output aval to alias: donating them
+    # would be silently dropped (JL007's heuristic is satisfied by the
+    # seq_buf donation; JP101 verifies the alias survives lowering).
+    donate_argnums=(6,),
 )
 def _spec_loop(
     cfg: ModelConfig,

@@ -1,0 +1,541 @@
+"""jaxprcheck (trace tier): per-rule fixtures, manifest lifecycle, gate.
+
+Three layers, mirroring tests/test_static_analysis.py:
+
+1. fixture tests — every JP rule fires on a known-bad jitted program and
+   stays quiet on the known-good rewrite (the before/after pairs in
+   docs/quickstart/static_analysis.md);
+2. manifest tests — round-trip (``--update`` then audit is clean, and a
+   second ``--update`` is a no-op), drift detection (mutating a donation
+   in a fixture registry OR the locked file fails CI with a readable
+   diff), suppression policy (reasons required);
+3. the tier-1 gate — zero unsuppressed error-tier findings over the REAL
+   program registry against the checked-in manifest, fp8+bf16 grid
+   coverage, and the mixed tick's 2-dispatch JP106 gate.
+"""
+
+import json
+import warnings
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ipex_llm_tpu.analysis import core
+from ipex_llm_tpu.analysis.trace import manifest as manifest_mod
+from ipex_llm_tpu.analysis.trace import rules as jp
+from ipex_llm_tpu.analysis.trace import runner
+from ipex_llm_tpu.analysis.trace.registry import ProgramSpec, real_registry
+from ipex_llm_tpu.analysis.trace.tickaudit import (TickSpec,
+                                                   mixed_tick_dispatch_count)
+from ipex_llm_tpu.analysis.trace.tracer import trace_entry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def codes(findings, suppressed=False):
+    return [f.rule for f in findings if f.suppressed == suppressed]
+
+
+def errors(findings):
+    return [f for f in findings
+            if not f.suppressed and f.severity == "error"]
+
+
+def sds(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# --------------------------------------------------------------------------
+# fixture programs (tiny: lowering is milliseconds)
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=(0,))
+def _fx_donated(state, x):
+    return state + x, x.sum()
+
+
+@jax.jit
+def _fx_undonated(state, x):
+    return state + x, x.sum()
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def _fx_held_donated(state, x):
+    return state + x, x * 1.0
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _fx_donation_dropped(state, x):
+    return (state * 2.0).sum(), x + 1.0
+
+
+_POOL_SHAPE = (2, 8, 2, 16, 8)      # [L, P, H, page, D]
+
+
+@jax.jit
+def _fx_fp8_upcast(pool, idx):
+    wide = pool.astype(jnp.bfloat16)            # wholesale pool upcast
+    return jnp.take(wide, idx, axis=1).sum(), pool
+
+
+@jax.jit
+def _fx_fp8_dequant_at_read(pool, idx):
+    tile = jnp.take(pool, idx, axis=1)          # gather e5m2 codes
+    return tile.astype(jnp.bfloat16).sum(), pool
+
+
+@jax.jit
+def _fx_callback(x):
+    jax.debug.print("x sum {}", x.sum())
+    return x * 2
+
+
+_FX_CONST = jnp.arange(32768, dtype=jnp.float32)          # 128 KiB
+_FX_SMALL_CONST = jnp.arange(16, dtype=jnp.float32)
+
+
+@jax.jit
+def _fx_bloated(x):
+    return x + _FX_CONST
+
+
+@jax.jit
+def _fx_lean(x):
+    return x + _FX_SMALL_CONST
+
+
+def _state_build(pt):
+    return (sds(64, 64), sds(64, 64)), {}
+
+
+def _pool_build(pt):
+    return (sds(*_POOL_SHAPE, dtype=jnp.float8_e5m2),
+            sds(3, dtype=jnp.int32)), {}
+
+
+def _vec_build(pt):
+    return (sds(32768),), {}
+
+
+def _vec16_build(pt):
+    return (sds(16),), {}
+
+
+def _mismatched_build(pt):
+    # x deliberately a different aval than state: the state donation has
+    # no output to alias with and lowering must drop it
+    return (sds(64, 64), sds(32, 32)), {}
+
+
+def mkspec(fn, build, arg_names, name="fx.prog", grid=({},), **over):
+    kw = dict(name=name, fn=fn, build=build, grid=tuple(grid),
+              arg_names=tuple(arg_names), max_lowerings=8)
+    kw.update(over)
+    return ProgramSpec(**kw)
+
+
+def _entry(spec, point=None):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # DonationWarning fixtures
+        return trace_entry(spec, point or {})
+
+
+# --------------------------------------------------------------------------
+# JP101 donation-coverage
+# --------------------------------------------------------------------------
+
+STATE_SPEC = dict(build=_state_build, arg_names=("state", "x"),
+                  dead=frozenset({"state"}), held=frozenset({"x"}))
+
+
+def test_jp101_fires_on_undonated_dead_input():
+    spec = mkspec(_fx_undonated, **STATE_SPEC)
+    found = list(jp.check_donation(spec, _entry(spec)))
+    assert [f.rule for f in found] == ["JP101"]
+    assert "re-uploaded" in found[0].message
+    assert found[0].tier == "trace"
+
+
+def test_jp101_quiet_when_donated():
+    spec = mkspec(_fx_donated, **STATE_SPEC)
+    entry = _entry(spec)
+    assert list(jp.check_donation(spec, entry)) == []
+    # and the alias really survived lowering
+    assert any(l.alias is not None for l in entry.leaves
+               if l.arg == "state")
+
+
+def test_jp101_flags_donated_but_held_buffer():
+    spec = mkspec(_fx_held_donated, **STATE_SPEC)
+    found = list(jp.check_donation(spec, _entry(spec)))
+    assert any(f.rule == "JP101" and "use-after-donate" in f.message
+               for f in found)
+
+
+def test_jp101_flags_donation_that_lowering_dropped():
+    spec = mkspec(_fx_donation_dropped, **{**STATE_SPEC,
+                                           "build": _mismatched_build})
+    found = list(jp.check_donation(spec, _entry(spec)))
+    assert any("no alias" in f.message for f in found)
+
+
+def test_jp101_small_dead_inputs_are_not_demanded():
+    spec = mkspec(_fx_undonated, **{**STATE_SPEC,
+                                    "min_donate_bytes": 1 << 20})
+    assert list(jp.check_donation(spec, _entry(spec))) == []
+
+
+# --------------------------------------------------------------------------
+# JP102 fp8-pool integrity
+# --------------------------------------------------------------------------
+
+POOL_SPEC = dict(build=_pool_build, arg_names=("pool", "idx"),
+                 held=frozenset({"pool"}))
+
+
+def test_jp102_fires_on_wholesale_pool_upcast():
+    spec = mkspec(_fx_fp8_upcast, **POOL_SPEC)
+    found = list(jp.check_fp8_integrity(spec, _entry(spec)))
+    assert [f.rule for f in found] == ["JP102"]
+    assert "upcast" in found[0].message
+
+
+def test_jp102_quiet_on_dequant_at_read():
+    spec = mkspec(_fx_fp8_dequant_at_read, **POOL_SPEC)
+    assert list(jp.check_fp8_integrity(spec, _entry(spec))) == []
+
+
+def test_jp102_quiet_without_fp8_inputs():
+    spec = mkspec(_fx_donated, **STATE_SPEC)
+    assert list(jp.check_fp8_integrity(spec, _entry(spec))) == []
+
+
+# --------------------------------------------------------------------------
+# JP103 host callbacks / JP105 constant bloat
+# --------------------------------------------------------------------------
+
+def test_jp103_fires_on_debug_print():
+    spec = mkspec(_fx_callback, _vec_build, ("x",))
+    found = list(jp.check_callbacks(spec, _entry(spec)))
+    assert [f.rule for f in found] == ["JP103"]
+    assert "debug_callback" in found[0].message
+
+
+def test_jp103_quiet_on_callback_free_program():
+    spec = mkspec(_fx_lean, _vec16_build, ("x",))
+    assert list(jp.check_callbacks(spec, _entry(spec))) == []
+
+
+def test_jp105_fires_on_baked_constant():
+    spec = mkspec(_fx_bloated, _vec_build, ("x",))
+    found = list(jp.check_constant_bloat(spec, _entry(spec)))
+    assert [f.rule for f in found] == ["JP105"]
+    assert found[0].severity == "warn"
+
+
+def test_jp105_quiet_under_threshold():
+    spec = mkspec(_fx_lean, _vec16_build, ("x",))
+    assert list(jp.check_constant_bloat(spec, _entry(spec))) == []
+
+
+# --------------------------------------------------------------------------
+# JP104 recompile surface (and signature dedupe)
+# --------------------------------------------------------------------------
+
+def test_jp104_bounds_the_grid_lowering_count(tmp_path):
+    def build(pt):
+        return (sds(pt["n"], 64), sds(pt["n"], 64)), {}
+
+    spec = mkspec(_fx_donated, build, ("state", "x"),
+                  grid=({"n": 16}, {"n": 32}, {"n": 64}),
+                  dead=frozenset({"state"}), max_lowerings=2)
+    findings = runner.audit(specs=(spec,), ticks=(),
+                            manifest_path=tmp_path / "m.json", update=True)
+    assert any(f.rule == "JP104" and "above the spec bound" in f.message
+               for f in findings)
+
+
+def test_jp104_dedupes_identical_signatures(tmp_path):
+    spec = mkspec(_fx_donated, _state_build, ("state", "x"),
+                  grid=({"rep": 1}, {"rep": 2}),   # same avals + statics
+                  dead=frozenset({"state"}), max_lowerings=1)
+    findings = runner.audit(specs=(spec,), ticks=(),
+                            manifest_path=tmp_path / "m.json", update=True)
+    assert not any(f.rule == "JP104" for f in findings)
+    lock = json.loads((tmp_path / "m.json").read_text())
+    assert lock["programs"]["fx.prog"]["lowerings"] == 1
+
+
+# --------------------------------------------------------------------------
+# JP106 tick dispatch count
+# --------------------------------------------------------------------------
+
+_TICK_SRC = '''
+import jax
+from functools import partial
+
+@partial(jax.jit)
+def _prog_a(x):
+    return x
+
+@partial(jax.jit)
+def _prog_b(x):
+    return x
+
+@partial(jax.jit)
+def _prog_alt(x):
+    return x
+
+{extra_def}
+
+def _mixed_step(self):
+    y = _prog_a(1)
+    {extra_call}
+    return _horizon_step(y)
+
+def _horizon_step(y):
+    if y:
+        return _prog_alt(y)
+    return _prog_b(y)
+'''
+
+
+def _tick_spec(**over):
+    kw = dict(name="mixed", module="fixture", programs=("_prog_a", "_prog_b"),
+              entries=("_mixed_step", "_horizon_step"),
+              alternates=("_prog_alt",), max_dispatches=2)
+    kw.update(over)
+    return TickSpec(**kw)
+
+
+def test_jp106_quiet_on_declared_two_dispatch_tick():
+    src = _TICK_SRC.format(extra_def="", extra_call="pass")
+    from ipex_llm_tpu.analysis.trace.tickaudit import discover_tick_dispatches
+
+    tick = _tick_spec()
+    found = list(jp.check_tick_dispatches(
+        tick, discover_tick_dispatches(tick, src)))
+    assert found == []
+
+
+def test_jp106_fires_on_a_third_dispatch_sneaking_in():
+    src = _TICK_SRC.format(
+        extra_def="@partial(jax.jit)\ndef _prog_c(x):\n    return x",
+        extra_call="_prog_c(y)")
+    from ipex_llm_tpu.analysis.trace.tickaudit import discover_tick_dispatches
+
+    tick = _tick_spec()
+    found = list(jp.check_tick_dispatches(
+        tick, discover_tick_dispatches(tick, src)))
+    assert any(f.rule == "JP106" and "_prog_c" in f.message for f in found)
+    assert any("above the gate" in f.message for f in found)
+
+
+def test_real_mixed_tick_issues_two_dispatches():
+    # the serving_bench row stamps this number; the superkernel roadmap
+    # item tightens it to 1
+    assert mixed_tick_dispatch_count() == 2
+
+
+# --------------------------------------------------------------------------
+# manifest lifecycle
+# --------------------------------------------------------------------------
+
+def _good_specs():
+    return (mkspec(_fx_donated, **STATE_SPEC),)
+
+
+def test_manifest_roundtrip_and_update_noop(tmp_path):
+    path = tmp_path / "lock.json"
+    first = runner.audit(specs=_good_specs(), ticks=(),
+                         manifest_path=path, update=True)
+    assert errors(first) == []
+    before = path.read_text()
+    clean = runner.audit(specs=_good_specs(), ticks=(), manifest_path=path)
+    assert errors(clean) == []
+    runner.audit(specs=_good_specs(), ticks=(), manifest_path=path,
+                 update=True)
+    assert path.read_text() == before     # --update is a no-op when clean
+
+
+def test_manifest_missing_is_an_error(tmp_path):
+    findings = runner.audit(specs=_good_specs(), ticks=(),
+                            manifest_path=tmp_path / "absent.json")
+    assert any(f.rule == "JP100" and "manifest missing" in f.message
+               for f in errors(findings))
+
+
+def test_mutated_donation_in_registry_fails_ci_shaped(tmp_path):
+    """Lock the donated fixture, then swap in the un-donated twin (same
+    avals): the audit must fail with JP101 AND a readable manifest diff."""
+    path = tmp_path / "lock.json"
+    runner.audit(specs=_good_specs(), ticks=(), manifest_path=path,
+                 update=True)
+    mutated = (mkspec(_fx_undonated, **STATE_SPEC),)
+    findings = runner.audit(specs=mutated, ticks=(), manifest_path=path)
+    errs = errors(findings)
+    assert any(f.rule == "JP101" for f in errs)
+    drift = [f for f in errs if f.rule == "JP100"]
+    assert drift and all("manifest drift" in f.message for f in drift)
+    assert any("state" in f.message for f in drift)   # names the alias
+
+
+def test_mutated_lock_file_is_drift(tmp_path):
+    path = tmp_path / "lock.json"
+    runner.audit(specs=_good_specs(), ticks=(), manifest_path=path,
+                 update=True)
+    lock = json.loads(path.read_text())
+    entry = next(iter(lock["programs"]["fx.prog"]["entries"].values()))
+    entry["flops"] += 999
+    path.write_text(json.dumps(lock))
+    findings = runner.audit(specs=_good_specs(), ticks=(),
+                            manifest_path=path)
+    assert any(f.rule == "JP100" and "flops" in f.message
+               for f in errors(findings))
+
+
+# --------------------------------------------------------------------------
+# suppression policy (registry-level, same rules as jaxlint comments)
+# --------------------------------------------------------------------------
+
+def test_spec_suppression_with_reason_is_honored(tmp_path):
+    spec = mkspec(_fx_undonated, **STATE_SPEC,
+                  suppress=(("JP101", "fixture: donation intentionally "
+                                      "omitted for the bad-fires test"),))
+    findings = runner.audit(specs=(spec,), ticks=(),
+                            manifest_path=tmp_path / "m.json", update=True)
+    assert not any(f.rule == "JP101" for f in errors(findings))
+    assert "JP101" in codes(findings, suppressed=True)
+
+
+def test_spec_suppression_without_reason_is_rejected(tmp_path):
+    spec = mkspec(_fx_undonated, **STATE_SPEC, suppress=(("JP101", ""),))
+    findings = runner.audit(specs=(spec,), ticks=(),
+                            manifest_path=tmp_path / "m.json", update=True)
+    assert any(f.rule == "JP100" and "no reason" in f.message
+               for f in errors(findings))
+    # the unsuppressed JP101 still reports too
+    assert any(f.rule == "JP101" for f in errors(findings))
+
+
+# --------------------------------------------------------------------------
+# the real registry: tier-1 gate
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def real_audit():
+    return runner.audit()
+
+
+def test_real_registry_zero_unsuppressed_errors(real_audit):
+    errs = errors(real_audit)
+    assert errs == [], "\n".join(f.render() for f in errs)
+
+
+def test_real_registry_covers_fp8_and_bf16_grids():
+    pool_programs = {"serving.decode_multi_step", "serving.mixed_prefill",
+                     "serving.prefill_chunk", "serving.verify_step"}
+    for spec in real_registry():
+        if spec.name in pool_programs:
+            kvs = {pt["kv"] for pt in spec.grid}
+            assert kvs == {"bf16", "fp8"}, spec.name
+
+
+def test_real_registry_names_every_issue_entry():
+    names = {s.name for s in real_registry()}
+    assert {"serving.decode_multi_step", "serving.mixed_prefill",
+            "serving.prefill_chunk", "serving.verify_step",
+            "serving.pp_decode_sample", "serving.pp_verify_step",
+            "generation.prefill_step", "generation.decode_loop",
+            "generation.decode_one", "multimodal.mm_prefill",
+            "multimodal.mm_decode",
+            "structured.json_decode_step"} <= names
+
+
+def test_checked_in_manifest_matches_tree(real_audit):
+    # drift against ipex_llm_tpu/analysis/programs.lock.json IS a finding
+    assert not any(f.rule == "JP100" and "drift" in f.message
+                   for f in real_audit), \
+        "\n".join(f.render() for f in real_audit if f.rule == "JP100")
+    assert manifest_mod.DEFAULT_PATH.exists()
+
+
+def test_manifest_locks_engine_donation_map():
+    lock = json.loads(manifest_mod.DEFAULT_PATH.read_text())
+    entries = lock["programs"]["serving.decode_multi_step"]["entries"]
+    for key, entry in entries.items():
+        aliased_args = {a.split("[")[0] for a in entry["aliases"]}
+        # the full dead set aliases; the held set never does — including
+        # the PRNG key, which _checkpoint snapshots by reference for the
+        # transient-retry contract (donating it hands rollback a deleted
+        # buffer; tests/test_serving_faults.py replays that fault path)
+        assert {"cache", "toks", "row_lens", "active", "steps",
+                "remain"} <= aliased_args, key
+        assert not aliased_args & {"temps", "top_ps", "seeds", "top_ks",
+                                   "eos", "key"}, key
+
+
+def test_alias_parse_tolerates_quoted_sharding_braces():
+    """mhlo.sharding attrs carry quoted nested braces; a flat brace regex
+    truncated the attr dict and silently dropped real aliases (which
+    would fail JP101 on a correct sharded tree)."""
+    from ipex_llm_tpu.analysis.trace.tracer import parse_output_aliases
+
+    line = ('  func.func public @main(%arg0: tensor<8x4xf32> '
+            '{mhlo.sharding = "{maximal device=0}", '
+            'tf.aliasing_output = 0 : i32}, '
+            '%arg1: tensor<8x4xf32> {mhlo.sharding = "{replicated}"}, '
+            '%arg2: tensor<4xf32> {tf.aliasing_output = 2 : i32}) '
+            '-> (tensor<8x4xf32> {jax.result_info = "[0]"}) {')
+    assert parse_output_aliases("module {\n" + line + "\n}") \
+        == {0: 0, 2: 2}
+
+
+# --------------------------------------------------------------------------
+# CLI: exit codes and schema
+# --------------------------------------------------------------------------
+
+def test_trace_findings_carry_tier_in_json():
+    spec = mkspec(_fx_undonated, **STATE_SPEC)
+    found = list(jp.check_donation(spec, _entry(spec)))
+    data = json.loads(core.to_json(found))
+    assert data["version"] == 1
+    assert data["findings"][0]["tier"] == "trace"
+    # AST findings carry tier="ast" (additive schema-v1 field)
+    from ipex_llm_tpu.analysis import analyze_source
+
+    ast_f = analyze_source("import jax.numpy as jnp\n"
+                           "def up(buf):\n    return jnp.asarray(buf)\n",
+                           "ipex_llm_tpu/serving/snippet.py")
+    assert json.loads(core.to_json(ast_f))["findings"][0]["tier"] == "ast"
+
+
+def test_cli_distinct_exit_code_for_internal_error(monkeypatch, capsys):
+    from ipex_llm_tpu.analysis import __main__ as cli
+
+    def boom(**kw):
+        raise RuntimeError("tracer exploded")
+
+    monkeypatch.setattr(runner, "audit", boom)
+    assert cli.main(["--trace"]) == 3
+    assert "tracer exploded" in capsys.readouterr().err
+
+
+def test_cli_usage_error_exit_code():
+    from ipex_llm_tpu.analysis import __main__ as cli
+
+    assert cli.main(["--update"]) == 2          # --update needs --trace
+    assert cli.main(["/nonexistent/path"]) == 2
+
+
+def test_cli_findings_exit_code(tmp_path):
+    from ipex_llm_tpu.analysis import __main__ as cli
+
+    bad = tmp_path / "ipex_llm_tpu" / "serving" / "snippet.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import jax.numpy as jnp\n"
+                   "def up(buf):\n    return jnp.asarray(buf)\n")
+    assert cli.main([str(bad)]) == 1

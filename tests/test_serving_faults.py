@@ -583,3 +583,37 @@ def test_dead_engine_fails_clients_instead_of_hanging(cfg_params):
     finally:
         loop.call_soon_threadsafe(loop.stop)
         eng.stop()
+
+
+# -- donation vs the rollback contract (PR 6 trace-audit sweep) -------------
+
+def test_rollback_after_decode_dispatch_restores_usable_key(cfg_params):
+    """_checkpoint snapshots self.key BY REFERENCE (the bit-identical
+    retry contract), so the fused decode program must never donate the
+    key: a fault landing AFTER the dispatch (async XLA faults surface at
+    the d2h sync) rolls back to that snapshot, and a donated key would be
+    a deleted buffer — every retry would then fail, turning a retryable
+    transient into mis-quarantine/_fail_all.  Regression for the PR 6
+    donation sweep: replay checkpoint -> decode tick -> rollback and
+    prove the engine keeps ticking on the restored key."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(**EC))
+    req = Request(prompt_ids=list(range(1, 30)), max_new_tokens=6)
+    eng.submit(req)
+    for _ in range(200):                     # advance into steady decode
+        eng._tick()
+        if len(req.output_ids) >= 2:
+            break
+    assert len(req.output_ids) >= 2 and req.finish_reason is None
+    snap = eng._checkpoint()
+    eng._staging, eng._tick_arrivals = [], []
+    eng._step_once()                         # dispatches the donated program
+    eng._rollback(snap)                      # the fault-path restore
+    assert not eng.key.is_deleted()          # snapshot survived the dispatch
+    out_before = len(req.output_ids)
+    for _ in range(200):                     # the retried ticks must commit
+        eng._tick()
+        if req.finish_reason is not None:
+            break
+    assert req.finish_reason == "length"
+    assert len(req.output_ids) == 6 and len(req.output_ids) > out_before

@@ -457,8 +457,11 @@ def test_json_schema_stable():
     assert set(doc["counts"]) == {"errors", "warnings", "suppressed"}
     assert doc["counts"]["errors"] >= 1
     f = doc["findings"][0]
-    assert set(f) == {"rule", "severity", "path", "line", "col", "message",
-                      "suppressed", "reason"}
+    # schema v1 is additive: every original field stays, and the trace
+    # tier's "tier" discriminator joins without bumping the version
+    assert {"rule", "severity", "path", "line", "col", "message",
+            "suppressed", "reason"} <= set(f)
+    assert f["tier"] == "ast"
 
 
 # --------------------------------------------------------------------------
