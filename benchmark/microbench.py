@@ -26,6 +26,7 @@ from ipex_llm_tpu.ops.linear import qmatmul_reference
 from ipex_llm_tpu.ops.pallas.qmatmul import qmatmul_pallas
 from ipex_llm_tpu.ops.pallas.decode_attention import decode_sdpa
 from ipex_llm_tpu.ops.pallas.paged_attention import paged_decode_sdpa
+from ipex_llm_tpu.ops.pallas.ragged_paged_attention import ragged_paged_sdpa
 from ipex_llm_tpu.ops.attention import sdpa_reference
 from ipex_llm_tpu.quantize import quantize
 
@@ -168,6 +169,49 @@ def bench_paged_decode_attn(r, hq, hkv, maxp, ps, d, dtype=jnp.bfloat16,
             "xla_gbs": round(nbytes / tr / 1e9, 1)}
 
 
+def bench_ragged_attn(r, hq, hkv, maxp, ps, d, width, dtype=jnp.bfloat16,
+                      iters=50):
+    """The superkernel tick's attention shape: a MIXED batch where half
+    the rows are decode rows (chunk_len 1) and half are ragged prefill
+    chunks (chunk_len up to ``width``), all against the paged pool in one
+    program (ops/pallas/ragged_paged_attention.py) vs the gather-then-
+    dense XLA fallback.  These rows are the measured ladder
+    ops/dispatch.py's data-driven backend choice keys on
+    (op families ``ragged_attn`` / ``ragged_attn_fp8``)."""
+    rng = np.random.default_rng(2)
+    cache, k, v = _paged_fixture(r, hkv, maxp, ps, d, dtype)
+    q = jnp.asarray(rng.standard_normal((r, width, hq, d)), jnp.bfloat16)
+    # even rows decode at full history; odd rows prefill a ragged chunk
+    chunk = np.where(np.arange(r) % 2 == 0, 1,
+                     1 + np.arange(r) % width).astype(np.int32)
+    kv_len = np.where(chunk == 1, maxp * ps,
+                      maxp * ps - width + chunk).astype(np.int32)
+    chunk, kv_len = jnp.asarray(chunk), jnp.asarray(kv_len)
+    nbytes = 2 * r * maxp * ps * hkv * d * k.dtype.itemsize
+
+    f_kern = jax.jit(lambda q, k, v: ragged_paged_sdpa(
+        q, k, v, cache.tables, kv_len, chunk))
+
+    def ref(q, k, v):
+        kd = cache.gather_layer(k).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        vd = cache.gather_layer(v).astype(jnp.bfloat16).transpose(0, 2, 1, 3)
+        qpos = kv_len[:, None] - chunk[:, None] + jnp.arange(width)[None, :]
+        return sdpa_reference(q, kd, vd, causal=True, q_positions=qpos,
+                              kv_len=kv_len)
+    f_ref = jax.jit(ref)
+    tk = timeit(f_kern, q, k, v, iters=iters)
+    tr = timeit(f_ref, q, k, v, iters=iters)
+    print(f"ragged_attn R={r} Hq={hq} Hkv={hkv} S={maxp*ps} W={width} "
+          f"D={d} {k.dtype}: kernel {tk*1e6:8.1f}us "
+          f"({nbytes/tk/1e9:6.1f} GB/s) | xla {tr*1e6:8.1f}us "
+          f"({nbytes/tr/1e9:6.1f} GB/s)")
+    return {"op": (f"ragged_attn_r{r}_h{hq}/{hkv}_s{maxp*ps}_w{width}"
+                   f"_d{d}_{k.dtype.name}"),
+            "pallas_us": round(tk * 1e6, 1), "xla_us": round(tr * 1e6, 1),
+            "pallas_gbs": round(nbytes / tk / 1e9, 1),
+            "xla_gbs": round(nbytes / tr / 1e9, 1)}
+
+
 def collect(iters: int = 20) -> list[dict]:
     """Compact per-kernel summary for the BENCH artifact (fail-soft: an op
     whose kernel path is ineligible on this backend is skipped).
@@ -196,6 +240,11 @@ def collect(iters: int = 20) -> list[dict]:
              {"iters": iters}),
             (bench_paged_decode_attn, (16, 32, 8, 16, 128, 128),
              {"dtype": jnp.float8_e5m2, "iters": iters}),  # fp8 paged KV
+            # superkernel tick shape: mixed decode + ragged prefill rows
+            (bench_ragged_attn, (16, 32, 8, 16, 128, 128, 32),
+             {"iters": iters}),
+            (bench_ragged_attn, (16, 32, 8, 16, 128, 128, 32),
+             {"dtype": jnp.float8_e5m2, "iters": iters}),
         ]
     else:
         # interpret-mode shapes: small enough that the Pallas interpreter
@@ -210,6 +259,11 @@ def collect(iters: int = 20) -> list[dict]:
              {"dtype": jnp.float8_e5m2, "iters": 2}),
             (bench_paged_decode_attn, (2, 8, 4, 4, 32, 64),
              {"dtype": jnp.float8_e5m2, "iters": 2}),     # fp8 paged KV
+            # superkernel tick shape (interpret record): the ragged_attn
+            # ladder rows the data-driven dispatch policy keys on
+            (bench_ragged_attn, (2, 8, 4, 4, 32, 64, 8), {"iters": 2}),
+            (bench_ragged_attn, (2, 8, 4, 4, 32, 64, 8),
+             {"dtype": jnp.float8_e5m2, "iters": 2}),
         ]
     for fn, args, kw in jobs:
         try:
@@ -242,3 +296,6 @@ if __name__ == "__main__":
     bench_paged_gather(16, 8, 16, 128, 128, jnp.float8_e5m2)
     bench_paged_decode_attn(16, 32, 8, 16, 128, 128)
     bench_paged_decode_attn(16, 32, 8, 16, 128, 128, jnp.float8_e5m2)
+    # ragged superkernel batch (mixed decode + prefill rows), bf16 vs fp8
+    bench_ragged_attn(16, 32, 8, 16, 128, 128, 32)
+    bench_ragged_attn(16, 32, 8, 16, 128, 128, 32, jnp.float8_e5m2)

@@ -310,9 +310,9 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
             "mixed_steps": m.get("mixed_steps", 0) - m0.get("mixed_steps", 0),
             # the AUDITED per-tick dispatch count (jaxprcheck JP106 gate,
             # analysis/trace/tickaudit.py): how many device programs one
-            # mixed prefill+decode tick can issue — 2 today; the ragged
-            # paged-attention superkernel roadmap item drives it to 1, and
-            # BENCH_r06+ tracks the value next to the throughput it buys
+            # mixed prefill+decode tick can issue — EXACTLY 1 since the
+            # ragged paged-attention superkernel tick (_ragged_tick_fn);
+            # BENCH rounds track the value next to the throughput it buys
             "tick_dispatches": _audited_tick_dispatches(),
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),

@@ -154,6 +154,23 @@ def _build_decode_multi_step(pt):
             _i32(r, 2), _i32(r)), {"horizon": pt["horizon"], "mesh": None}
 
 
+def _build_ragged_tick(pt):
+    cfg, params = audit_model()
+    r = pt["rows"]
+    base = (cfg, params, _paged_cache(r, pt["kv"]), _i32(r), _i32(r),
+            _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r), _i32(r),
+            _i32(r, 2), _i32(r))
+    w = pt["width"]
+    if w:   # admission-wave form: pow2-padded prefill block rides along
+        p = 2
+        prefill = (_i32(p, w), _i32(p, 2), _i32(p), _i32(p), _bool(p),
+                   _bool(p), _i32(p))
+    else:   # steady-state form: pure decode horizon, no prefill block
+        prefill = None
+    return base, {"prefill": prefill, "horizon": pt["horizon"],
+                  "with_decode": pt.get("wd", True), "mesh": None}
+
+
 def _build_mixed_prefill(pt):
     cfg, params = audit_model()
     p = 2   # pow2-padded prefilling-row batch
@@ -257,6 +274,35 @@ def real_registry() -> tuple[ProgramSpec, ...]:
     kv_axis = ("bf16", "fp8")
     return (
         # -- serving/engine.py ------------------------------------------
+        ProgramSpec(
+            # THE tick program (JP106's one allowed dispatch): the grid
+            # covers the steady-state form (width=0: pure decode horizon,
+            # the _decode_multi_step-shaped program), the admission-wave
+            # form (prefill block at both pow2 chunk widths), AND the
+            # pure-chunk form (wd=False: prefill+merge with the decode
+            # stage statically skipped — a distinct jit variant with the
+            # same donation contract), each over bf16 and fp8 pools
+            name="serving.ragged_tick",
+            fn=engine._ragged_tick_fn,
+            build=_build_ragged_tick,
+            grid=(_grid(rows=(4, 8), width=(0,), horizon=(1, 8),
+                        kv=kv_axis)
+                  + _grid(rows=(4,), width=(8, 128), horizon=(1,),
+                          kv=kv_axis)
+                  + _grid(rows=(4,), width=(8,), horizon=(1,),
+                          wd=(False,), kv=kv_axis)),
+            arg_names=("params", "cache", "toks", "row_lens", "active",
+                       "temps", "top_ps", "key", "seeds", "steps",
+                       "top_ks", "eos", "remain"),
+            dead=frozenset({"cache", "toks", "row_lens", "active",
+                            "steps", "remain"}),
+            # key is HELD (checkpoint-by-reference, the PR 6 rule);
+            # sampling params/eos are epoch-held; the prefill block's
+            # arrays are fresh per-tick uploads, unlisted on purpose
+            held=frozenset({"params", "temps", "top_ps", "seeds",
+                            "top_ks", "eos", "key"}),
+            max_lowerings=14,
+        ),
         ProgramSpec(
             name="serving.decode_multi_step",
             fn=engine._decode_multi_step,

@@ -41,17 +41,21 @@ class TickSpec:
 
 
 def mixed_tick_spec() -> TickSpec:
-    """The mixed prefill+decode tick: ONE batched ragged-chunk program
-    (``_mixed_prefill_fn``) chained with ONE fused decode program
-    (``_decode_multi_step``) — 2 dispatches today; ROADMAP item 1's
-    superkernel tightens this gate to 1."""
+    """The mixed prefill+decode tick: ONE fused program
+    (``_ragged_tick_fn`` — ragged prefill, on-device first-token merge,
+    and the decode horizon in a single jitted entry; the steady-state
+    tick is the same entry with no prefill block).  The gate is EXACTLY
+    1 dispatch per tick — the ragged paged-attention superkernel
+    invariant (ROADMAP item 1, landed); the chained
+    ``_mixed_prefill_fn`` + ``_decode_multi_step`` pair survives only as
+    the equivalence oracle, unreachable from the tick entries."""
     return TickSpec(
         name="mixed",
         module="ipex_llm_tpu.serving.engine",
         entries=("_mixed_step", "_horizon_step"),
-        programs=("_mixed_prefill_fn", "_decode_multi_step"),
+        programs=("_ragged_tick_fn",),
         alternates=("_pp_decode_sample",),   # pp engines route H=1 here
-        max_dispatches=2,
+        max_dispatches=1,
     )
 
 

@@ -69,7 +69,8 @@ def _in_norm(x, lp, key, cfg):
 
 def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
                      q_slots, kv_len, kv_start, sliding, cache: KVCache,
-                     collect_obs: int = 0, bias=None, pre_normed=False):
+                     collect_obs: int = 0, bias=None, pre_normed=False,
+                     chunk_lens=None):
     b, t, _ = x.shape
     # olmo2-style reordered norm: attention sees the raw residual stream
     # and attn_norm applies to the block OUTPUT instead; pre_normed: the
@@ -117,6 +118,7 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
             compute_dtype=COMPUTE_DTYPE, causal=True, q_positions=q_slots,
             kv_len=kv_len, kv_start=kv_start, window=None, window_on=sliding,
             softcap=cfg.attn_softcap, scale=cfg.attn_scale,
+            chunk_lens=chunk_lens,
         )
         attn = attn.reshape(b, t, cfg.num_heads * cfg.v_dim)
         out = linear_ops.linear(attn, lp["o"], lp.get("o_bias"))
@@ -196,6 +198,7 @@ def _attention_block(cfg: ModelConfig, lp: dict, x, kl, vl, cos, sin, slot0,
         softcap=cfg.attn_softcap,
         scale=cfg.attn_scale,
         bias=bias,
+        chunk_lens=chunk_lens,
     )
     attn = attn.reshape(b, t, cfg.num_heads * cfg.head_dim)
     out = linear_ops.linear(attn, lp["o"], lp.get("o_bias"))
@@ -440,7 +443,7 @@ def logits_tail(cfg: ModelConfig, params, x):
 def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
                x, cos, sin, slot0, q_slots, kv_len, kv_start, cache,
                collect_obs: int = 0, alibi_bias=None,
-               cos_local=None, sin_local=None):
+               cos_local=None, sin_local=None, chunk_lens=None):
     """Scan one stacked layer tree over its cache slice.
 
     The single compiled layer body shared by decoder_forward and the
@@ -466,7 +469,7 @@ def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
             attn_out, kl, vl, obs_q = _attention_block(
                 cfg, lp, a_in, kl, vl, c, s_, slot0, q_slots, kv_len,
                 kv_start, sliding, cache, collect_obs, bias=alibi_bias,
-                pre_normed=True,
+                pre_normed=True, chunk_lens=chunk_lens,
             )
             x = a_in * alpha + attn_out
             m_in = _in_norm(x, lp, "mlp_norm", cfg)
@@ -475,6 +478,7 @@ def run_layers(cfg: ModelConfig, tree, k_stack, v_stack, sliding_flags,
         attn_out, kl, vl, obs_q = _attention_block(
             cfg, lp, x, kl, vl, c, s_, slot0, q_slots, kv_len, kv_start,
             sliding, cache, collect_obs, bias=alibi_bias,
+            chunk_lens=chunk_lens,
         )
         ffn = _moe_block if "moe_gate_up" in lp else _mlp_block
         # minicpm depth scaling (cfg.residual_multiplier, 1.0 elsewhere)
@@ -510,6 +514,7 @@ def decoder_forward(
     slot_offsets: jnp.ndarray | None = None,  # [B] per-row cache write slots
     input_embeds: jnp.ndarray | None = None,  # [B, T, H] bypasses the lookup
     gather_positions: jnp.ndarray | None = None,  # [B] per-row logits index
+    chunk_lens: jnp.ndarray | None = None,    # [B] valid tokens this call
 ):
     """Run the decoder; returns (logits, updated cache).
 
@@ -530,6 +535,15 @@ def decoder_forward(
     state BEFORE the lm head keeps the tail matmul at [B, 1, H] — the same
     shape (and therefore the same bitwise result) as the T=1 decode step's
     tail — instead of projecting every pad position.
+
+    ``chunk_lens`` [B] (with ``slot_offsets``) names each row's REAL token
+    count this call: the valid-KV bound becomes ``slot_offsets +
+    chunk_lens`` instead of the pad-inclusive ``slot_offsets + T``, and
+    the per-row raggedness flows into attention (the ragged paged kernel's
+    causal mask; a decode row is 1, an idle row 0).  Valid positions
+    compute bitwise what the pad-inclusive bound computes — the tighter
+    bound only stops pad queries (whose outputs are discarded) from
+    touching pad slots, and lets the kernel skip dead pages entirely.
     """
     from ipex_llm_tpu.ops.embedding import embed_lookup
 
@@ -543,7 +557,9 @@ def decoder_forward(
     if slot_offsets is not None:
         slot0 = slot_offsets                       # [B]
         q_slots = slot0[:, None] + jnp.arange(t)[None, :]
-        kv_len = slot0 + t
+        # ragged chunk: the valid-KV bound follows each row's REAL token
+        # count, not the right-padded width (pad queries are discarded)
+        kv_len = slot0 + (chunk_lens if chunk_lens is not None else t)
     else:
         slot0 = cache.length
         q_slots = jnp.broadcast_to(slot0 + jnp.arange(t)[None, :], (b, t))
@@ -571,6 +587,7 @@ def decoder_forward(
             cfg, tree, cache.k[lo:hi], cache.v[lo:hi], sliding_flags[lo:hi],
             x, cos, sin, slot0, q_slots, kv_len, kv_start, cache,
             collect_obs, alibi_bias, cos_local=cos_l, sin_local=sin_l,
+            chunk_lens=chunk_lens,
         )
         k_parts.append(kp)
         v_parts.append(vp)

@@ -143,15 +143,16 @@ def sdpa(
     return sdpa_reference(q, k, v, **kwargs)
 
 
-def _decode_kernel_mode(dispatch) -> str | None:
+def _decode_kernel_mode(dispatch, op: str | None = None) -> str | None:
     """Which decode-kernel variant the active dispatch state allows:
     'single' (no mesh, Pallas on), 'sharded' (tp mesh with shard_map
     wrappers), or None (jnp/gather fallback).  One policy for both the
-    paged and dense decode ladders in :func:`cached_sdpa`."""
+    paged and dense decode ladders in :func:`cached_sdpa`; ``op`` keys
+    the measured-ladder lookup (dispatch.use_pallas)."""
     mesh = dispatch.spmd_mesh()
     if mesh is None:
-        return "single" if dispatch.use_pallas() else None
-    if mesh.shape.get("tp", 1) > 1 and dispatch.use_pallas_sharded():
+        return "single" if dispatch.use_pallas(op) else None
+    if mesh.shape.get("tp", 1) > 1 and dispatch.use_pallas_sharded(op):
         return "sharded"
     return None
 
@@ -177,6 +178,7 @@ def cached_sdpa(
     """
     from ipex_llm_tpu.ops import dispatch
 
+    chunk_lens = kwargs.pop("chunk_lens", None)
     if hasattr(cache, "tables"):
         # paged pool layer (serving engine; rows right-aligned from slot 0,
         # queries at slots [kv_len - T, kv_len) — the engine's invariant).
@@ -185,13 +187,12 @@ def cached_sdpa(
         # ``xe_addons.sdp_fp8`` equivalent — HBM reads stay half-width),
         # and the gather fallback gathers the fp8 codes (still half the
         # bytes) before ``decode_layer`` casts once next to the op.
-        # The mixed prefill+decode step rides this same path with a RAGGED
-        # right-padded chunk: each row's real queries are a PREFIX of its
-        # [kv_len - T, kv_len) window (a decode row has 1, a prefill row up
-        # to T, an idle row 0), and the pad tail past a row's last valid
-        # token reads only scratch-page garbage that causal masking hides
-        # — so one T>1 program serves every row shape in the batch without
-        # per-row dispatch.
+        # The ragged tick rides this same path with a RAGGED right-padded
+        # chunk: ``chunk_lens`` [B] names each row's real query count (a
+        # decode row has 1, a prefill row up to T, an idle row 0), and the
+        # pad tail past a row's last valid token is causally hidden — so
+        # ONE kernel program (ops/pallas/ragged_paged_attention.py) serves
+        # every row shape in the batch without per-row dispatch.
         if (
             kwargs.get("bias") is None
             and kwargs.get("window") is None
@@ -201,31 +202,35 @@ def cached_sdpa(
             and q.shape[2] % kl.shape[1] == 0
         ):
             # read ONLY the row's own pages through the scalar-prefetched
-            # block table — no table-width gather: T=1 decode kernel or the
-            # chunked-prefill kernel (T>1, causal in-kernel)
-            mode = _decode_kernel_mode(dispatch)
+            # block table — no table-width gather.  The op family key
+            # makes the backend choice data-driven from the measured
+            # microbench ladder (dispatch._BUILTIN_LADDER / the env
+            # override): the same rows microbench records are what decide
+            # kernel-vs-XLA here.
+            op = ("ragged_attn_fp8" if "float8" in str(kl.dtype)
+                  else "ragged_attn")
+            mode = _decode_kernel_mode(dispatch, op)
             if mode is not None:
                 try:
-                    from ipex_llm_tpu.ops.pallas import paged_attention
+                    from ipex_llm_tpu.ops.pallas import \
+                        ragged_paged_attention
 
-                    decode = q.shape[1] == 1
                     if mode == "single":
-                        fn = (paged_attention.paged_decode_sdpa if decode
-                              else paged_attention.paged_prefill_sdpa)
-                        return fn(q, kl, vl, cache.tables,
-                                  kwargs.get("kv_len"),
-                                  scale=kwargs.get("scale"))
+                        return ragged_paged_attention.ragged_paged_sdpa(
+                            q, kl, vl, cache.tables, kwargs.get("kv_len"),
+                            chunk_lens, scale=kwargs.get("scale"))
                     # TP serving: per-shard kernel over the kv-head split
-                    fn = (paged_attention.paged_decode_sdpa_sharded if decode
-                          else paged_attention.paged_prefill_sdpa_sharded)
-                    return fn(q, kl, vl, cache.tables, kwargs.get("kv_len"),
-                              dispatch.spmd_mesh(),
-                              scale=kwargs.get("scale"))
+                    return ragged_paged_attention.ragged_paged_sdpa_sharded(
+                        q, kl, vl, cache.tables, kwargs.get("kv_len"),
+                        dispatch.spmd_mesh(), chunk_lens,
+                        scale=kwargs.get("scale"))
                 except (ImportError, NotImplementedError):
                     pass
         # fallback: gather the rows' pages into the head-major
         # [B, Hkv, S, D] view; tail pages beyond kv_len are garbage and
-        # masked exactly like dense-cache slack
+        # masked exactly like dense-cache slack (per-row chunk lens are
+        # already folded into kv_len by the caller, so the reference mask
+        # needs no extra input)
         kl = cache.gather_layer(kl)
         vl = cache.gather_layer(vl)
 
@@ -245,7 +250,9 @@ def cached_sdpa(
             window_on=kwargs.get("window_on", True),
             softcap=kwargs.get("softcap"),
         )
-        mode = _decode_kernel_mode(dispatch)
+        op = ("decode_attn_fp8" if "float8" in str(kl.dtype)
+              else "decode_attn")
+        mode = _decode_kernel_mode(dispatch, op)
         if mode is not None:
             try:
                 from ipex_llm_tpu.ops.pallas import decode_attention

@@ -1,0 +1,28 @@
+"""Shims and tiny helpers shared by every Pallas kernel module.
+
+jax renamed ``TPUCompilerParams`` -> ``CompilerParams`` (0.4.38); accept
+both so the kernels lower (and interpret-run) on either side of the
+rename.  One definition — a third name in a future jax lands here, not
+in five copy-pasted blocks.  Same rule for the backend probe
+(``interpret``: a new TPU-like platform string is added once), the tile
+rounding helper, and the masking constant.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+NEG_INF = -1e30
+
+
+def interpret() -> bool:
+    """True off-TPU: kernels run in the (slow) Pallas interpreter."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

@@ -35,15 +35,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu", "axon")
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
+from ipex_llm_tpu.ops.pallas._compat import (
+    COMPILER_PARAMS as _COMPILER_PARAMS,
+    NEG_INF,
+    interpret as _interpret,
+    round_up as _round_up,
+)
 
 
 def _kernel(len_ref, start_ref, won_ref, q_ref, k_ref, v_ref, o_ref,
@@ -148,7 +145,7 @@ def _decode(q, k, v, kv_len, kv_start, won, *, scale, window, softcap,
             pltpu.VMEM((g_pad, 1), jnp.float32),
             pltpu.VMEM((g_pad, dv_pad), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -30,15 +30,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu", "axon")
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
+from ipex_llm_tpu.ops.pallas._compat import (
+    COMPILER_PARAMS as _COMPILER_PARAMS,
+    NEG_INF,
+    interpret as _interpret,
+    round_up as _round_up,
+)
 
 
 def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -141,7 +138,7 @@ def _paged(q, k_pool, v_pool, tables, kv_len, *, scale, out_dtype, chunk=1):
                           compute_dtype=jnp.bfloat16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((r, hkv, g_pad, dv_pad), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

@@ -34,6 +34,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ipex_llm_tpu.ops.pallas._compat import (
+    COMPILER_PARAMS as _COMPILER_PARAMS,
+    interpret as _interpret,
+    round_up as _round_up,
+)
+
 from ipex_llm_tpu.quantize import numerics
 from ipex_llm_tpu.quantize.core import QTensor
 
@@ -54,14 +60,6 @@ def _data_row_factor(qtype: str) -> tuple[int, int]:
     if qtype in _BIT5:
         return 8, 5
     return 1, 1
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu", "axon")
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
 
 
 def _codebook_select(codes: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
@@ -219,7 +217,7 @@ def _qmatmul_2d(x, data, scales, zeros, *, qtype: str, bs: int,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni)),
         out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

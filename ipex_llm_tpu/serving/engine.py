@@ -111,11 +111,11 @@ class EngineConfig:
     decode_horizon: int = 1
     # mixed prefill+decode step: per-tick prefill token budget for the
     # admission wave.  While ANY row is prefilling, the engine runs
-    # ``_mixed_step`` — one batched ragged-chunk program advances EVERY
-    # prefilling row (first tokens sampled on device for prompts that
-    # complete), chained with the fused decode program for every active
-    # row in the same tick (the Sarathi-style piggybacked chunked prefill
-    # the TPU ragged-paged-attention serving stacks use).  The budget
+    # ``_mixed_step`` — ONE fused program (``_ragged_tick_fn``) advances
+    # EVERY prefilling row by a ragged chunk, samples-and-merges first
+    # tokens on device for prompts that complete, and runs the decode
+    # step for every active row, all in a single dispatch (the TPU
+    # ragged-paged-attention superkernel tick; JP106 locks it).  The budget
     # fair-shares across joining rows in power-of-two per-row chunk
     # widths (bounded retraces); decode rows keep their ordinary [R, 1]
     # step cost.  None = auto (prefill_bucket); 0 disables the mixed step
@@ -269,27 +269,17 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
     return keys
 
 
-# donation covers the cache AND every dead-after-call piece of the
-# device-resident row state (toks/row_lens/active/steps/remain): the host
-# rebinds its _dev handles to the returned arrays each call, so the
-# inputs alias their advanced outputs instead of being copied per tick.
-# temps/top_ps/seeds/top_ks/eos are HELD — the host re-passes the same
-# buffers until the next epoch upload — and must never be donated.  The
-# PRNG key is held too, less obviously: _checkpoint snapshots self.key BY
-# REFERENCE for the bit-identical transient-retry contract, so donating
-# it would hand _rollback a deleted buffer whenever a fault lands after
-# the dispatch (the d2h sync is exactly where async XLA faults surface).
-# The trace audit (JP101 in analysis/trace/) locks both directions.
-@partial(jax.jit, static_argnames=("cfg", "horizon", "mesh"),
-         donate_argnums=(2, 3, 4, 5, 10, 13))
-def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
-                       active, temps, top_ps, key, seeds, steps, top_ks,
-                       eos, remain, horizon: int = 1, mesh=None):
-    """Fused decode horizon: up to ``horizon`` decode+sample steps over the
-    whole row pool in ONE device program (a ``lax.while_loop`` over the
-    donated cache — not ``lax.scan``, because the loop must exit early the
-    moment every row is dead) — the host syncs once per H tokens instead
-    of once per token.
+def _decode_horizon_loop(cfg: ModelConfig, params, cache, toks, row_lens,
+                         active, temps, top_ps, key, seeds, steps, top_ks,
+                         eos, remain, horizon: int):
+    """The fused decode horizon BODY: up to ``horizon`` decode+sample
+    steps over the whole row pool (a ``lax.while_loop`` — not
+    ``lax.scan``, because the loop must exit early the moment every row
+    is dead).  ONE definition, traced into BOTH jitted entries —
+    ``_decode_multi_step`` (the historical fused-decode program, kept as
+    the equivalence oracle) and ``_ragged_tick_fn`` (the single-dispatch
+    tick) — so the two programs cannot drift and the superkernel tick
+    stays bit-identical to the chained path by construction.
 
     toks [R] current token per row; row_lens [R] slots already in cache;
     eos [R, E] per-row stop ids (-1 pad); remain [R] output-token budget
@@ -301,16 +291,7 @@ def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
     position computes exactly what the H=1 step computes (same forward,
     same split-per-step key chain, same fold_in(seed, output_index)
     stream), so fused output is bit-identical to H=1.
-
-    ``mesh`` (static) marks TP serving: op dispatch then emits
-    shard_map-wrapped kernels, and its presence in the jit key keeps
-    single-device and sharded engines in one process from sharing a trace.
-    Returns ([R, H] tokens, [R, H] logprobs, the number of steps actually
-    executed (the horizon early-exits once EVERY row is dead — tail
-    quantization never pays for h-1 dead forwards), cache, and the
-    advanced device state: toks, row_lens, active, steps, remain, key).
     """
-    from ipex_llm_tpu.ops import dispatch
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
     def step(n, cache, toks, row_lens, alive, key, steps, remain):
@@ -346,38 +327,75 @@ def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
         return (n + 1, cache, toks, row_lens, alive, key, steps, remain,
                 nxt, lp)
 
-    with dispatch.spmd(mesh):
-        if horizon == 1:
-            # the H=1 program is the loop body inlined — structurally the
-            # same XLA program as the historical single-step decode
-            (n, cache, toks, row_lens, active, key, steps, remain, nxt,
-             lp) = step(jnp.asarray(0, jnp.int32), cache, toks, row_lens,
-                        active, key, steps, remain)
-            tok_block, lp_block = nxt[:, None], lp[:, None]
-        else:
-            r = toks.shape[0]
+    if horizon == 1:
+        # the H=1 program is the loop body inlined — structurally the
+        # same XLA program as the historical single-step decode
+        (n, cache, toks, row_lens, active, key, steps, remain, nxt,
+         lp) = step(jnp.asarray(0, jnp.int32), cache, toks, row_lens,
+                    active, key, steps, remain)
+        tok_block, lp_block = nxt[:, None], lp[:, None]
+    else:
+        r = toks.shape[0]
 
-            def body(carry):
-                n, cache, toks, row_lens, alive, key, steps, remain, tb, \
-                    lb = carry
-                (n1, cache, toks, row_lens, alive, key, steps, remain,
-                 nxt, lp) = step(n, cache, toks, row_lens, alive, key,
-                                 steps, remain)
-                tb = jax.lax.dynamic_update_index_in_dim(tb, nxt, n, 0)
-                lb = jax.lax.dynamic_update_index_in_dim(lb, lp, n, 0)
-                return (n1, cache, toks, row_lens, alive, key, steps,
-                        remain, tb, lb)
+        def body(carry):
+            n, cache, toks, row_lens, alive, key, steps, remain, tb, \
+                lb = carry
+            (n1, cache, toks, row_lens, alive, key, steps, remain,
+             nxt, lp) = step(n, cache, toks, row_lens, alive, key,
+                             steps, remain)
+            tb = jax.lax.dynamic_update_index_in_dim(tb, nxt, n, 0)
+            lb = jax.lax.dynamic_update_index_in_dim(lb, lp, n, 0)
+            return (n1, cache, toks, row_lens, alive, key, steps,
+                    remain, tb, lb)
 
-            init = (jnp.asarray(0, jnp.int32), cache, toks, row_lens,
-                    active, key, steps, remain,
-                    jnp.zeros((horizon, r), jnp.int32),
-                    jnp.zeros((horizon, r), jnp.float32))
-            (n, cache, toks, row_lens, active, key, steps, remain, tb,
-             lb) = jax.lax.while_loop(
-                lambda c: (c[0] < horizon) & c[4].any(), body, init)
-            tok_block, lp_block = tb.T, lb.T           # [H, R] -> [R, H]
+        init = (jnp.asarray(0, jnp.int32), cache, toks, row_lens,
+                active, key, steps, remain,
+                jnp.zeros((horizon, r), jnp.int32),
+                jnp.zeros((horizon, r), jnp.float32))
+        (n, cache, toks, row_lens, active, key, steps, remain, tb,
+         lb) = jax.lax.while_loop(
+            lambda c: (c[0] < horizon) & c[4].any(), body, init)
+        tok_block, lp_block = tb.T, lb.T               # [H, R] -> [R, H]
     return (tok_block, lp_block, n, cache, toks, row_lens, active, steps,
             remain, key)
+
+
+# donation covers the cache AND every dead-after-call piece of the
+# device-resident row state (toks/row_lens/active/steps/remain): the host
+# rebinds its _dev handles to the returned arrays each call, so the
+# inputs alias their advanced outputs instead of being copied per tick.
+# temps/top_ps/seeds/top_ks/eos are HELD — the host re-passes the same
+# buffers until the next epoch upload — and must never be donated.  The
+# PRNG key is held too, less obviously: _checkpoint snapshots self.key BY
+# REFERENCE for the bit-identical transient-retry contract, so donating
+# it would hand _rollback a deleted buffer whenever a fault lands after
+# the dispatch (the d2h sync is exactly where async XLA faults surface).
+# The trace audit (JP101 in analysis/trace/) locks both directions.
+@partial(jax.jit, static_argnames=("cfg", "horizon", "mesh"),
+         donate_argnums=(2, 3, 4, 5, 10, 13))
+def _decode_multi_step(cfg: ModelConfig, params, cache, toks, row_lens,
+                       active, temps, top_ps, key, seeds, steps, top_ks,
+                       eos, remain, horizon: int = 1, mesh=None):
+    """The historical fused-decode program: ``_decode_horizon_loop`` as
+    its own jitted entry.  The live tick path now routes through
+    ``_ragged_tick_fn`` (which traces the SAME loop body, so outputs are
+    bit-identical); this entry remains for the pre-superkernel callers
+    and as the chained-path oracle the equivalence tests drive.
+
+    ``mesh`` (static) marks TP serving: op dispatch then emits
+    shard_map-wrapped kernels, and its presence in the jit key keeps
+    single-device and sharded engines in one process from sharing a trace.
+    Returns ([R, H] tokens, [R, H] logprobs, the number of steps actually
+    executed (the horizon early-exits once EVERY row is dead — tail
+    quantization never pays for h-1 dead forwards), cache, and the
+    advanced device state: toks, row_lens, active, steps, remain, key).
+    """
+    from ipex_llm_tpu.ops import dispatch
+
+    with dispatch.spmd(mesh):
+        return _decode_horizon_loop(
+            cfg, params, cache, toks, row_lens, active, temps, top_ps,
+            key, seeds, steps, top_ks, eos, remain, horizon)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "n_micro"),
@@ -538,14 +556,15 @@ def _mixed_prefill_fn(cfg: ModelConfig, params, cache, tokens, base_lens,
     token is sampled here, on device, from the last valid position
     (fold_in(seed, 0) for seeded rows — the sequential engine's exact
     first-token stream), eliminating the per-chunk host sampling round
-    trip.  Returns ([P] tokens, [P] logprobs, cache, key); the host
-    blocks on them only on completion ticks — pure-chunk ticks dispatch
-    without a sync.  Decode rows ride the SAME engine tick through the
-    fused decode program (``_decode_multi_step`` at h=1) dispatched
-    back-to-back on the chained cache: two async dispatches, not
-    2 x rows + 2, and the decode cost stays [R, 1] instead of paying the
-    chunk width per decode token (which would tax compute-bound
-    backends).
+    trip.  Returns ([P] tokens, [P] logprobs, cache, key).
+
+    HISTORICAL NOTE: the live tick no longer dispatches this program —
+    ``_ragged_tick_fn`` fuses the same prefill stage with the decode
+    horizon into ONE dispatch (stage 1 there is this function's body
+    with per-row ``chunk_lens`` threaded into attention).  It remains
+    module-level-jitted as half of the chained two-program oracle the
+    equivalence suite (tests/test_serving_ragged.py) drives against the
+    fused tick.
     """
     from ipex_llm_tpu.ops import dispatch
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
@@ -561,6 +580,111 @@ def _mixed_prefill_fn(cfg: ModelConfig, params, cache, tokens, base_lens,
             logits, temps, top_ps, sub, seeds=seeds,
             steps=jnp.zeros_like(n_valid), top_ks=top_ks, active=emit)
     return nxt, lp, cache, key
+
+
+# Donation contract identical to _decode_multi_step (same positions):
+# cache/toks/row_lens/active/steps/remain are dead after the call — the
+# host rebinds its _dev handles to the returned arrays — while temps/
+# top_ps/seeds/top_ks/eos are held across epochs and the PRNG key is
+# checkpoint-held BY REFERENCE for bit-identical transient retry (PR 6's
+# rule), so neither may be donated.  The prefill block's arrays are fresh
+# per-tick uploads, too small to matter.  JP101 locks both directions.
+@partial(jax.jit, static_argnames=("cfg", "horizon", "with_decode", "mesh"),
+         donate_argnums=(2, 3, 4, 5, 10, 13))
+def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
+                    active, temps, top_ps, key, seeds, steps, top_ks,
+                    eos, remain, prefill=None, horizon: int = 1,
+                    with_decode: bool = True, mesh=None):
+    """ONE device program per engine tick, whatever the admission mix —
+    the ragged-paged-attention superkernel tick (ROADMAP item 1; the
+    JP106 gate counts exactly this entry).
+
+    Internally three fused stages, each optional per the tick's shape:
+
+    1. **ragged prefill** (``prefill`` is not None): the batched ragged
+       chunk forward over the prefilling rows — ``prefill`` is
+       ``(p_tokens [P, W], p_tables [P, maxp_b], p_base [P], p_nvalid
+       [P], p_emit [P], p_canjoin [P], p_rowmap [P])``, a row-sliced
+       table view plus the map from prefill slot to engine row (pad
+       slots carry ``p_rowmap == R`` so their scatters drop).  Attention
+       rides the per-row ``chunk_lens`` causal contract of
+       ops/pallas/ragged_paged_attention.py, and the per-row last-valid
+       hidden gather (``gather_positions``) is fused in.
+    2. **first-token sampling + state merge**: rows whose prompt
+       completes this tick (``p_emit``) sample their first token here —
+       fold_in(seed, 0), the sequential stream — and join the decode
+       state ON DEVICE exactly as the host's epoch upload would have
+       published them (toks=first, steps=1, remain-=1, active unless the
+       first token hit EOS / exhausted the budget / ``p_canjoin`` says
+       the host could not back the decode KV slot).  Every prefill row's
+       device length advances to its true value, so pure-chunk ticks
+       still need no epoch upload.
+    3. **the fused decode horizon** (``with_decode``): the SAME
+       ``_decode_horizon_loop`` body ``_decode_multi_step`` traces, over
+       the merged state — so decode output is bit-identical to the
+       chained two-program tick, and a steady-state tick (prefill=None)
+       lowers to structurally the historical fused-decode program.
+
+    ``with_decode=False`` (a pure-chunk tick with no decoding rows)
+    skips stage 3 entirely: no wasted all-masked forward, and the key
+    chain only advances by the prefill split — the chained path's exact
+    behaviour.  Returns (first_t [P], first_lp [P] — None without a
+    prefill block —, [R, H] tokens, [R, H] logprobs, steps executed,
+    cache, toks, row_lens, active, steps, remain, key).
+    """
+    from ipex_llm_tpu.ops import dispatch
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+
+    r = toks.shape[0]
+    first_t = first_lp = None
+    with dispatch.spmd(mesh):
+        if prefill is not None:
+            (p_tokens, p_tables, p_base, p_nvalid, p_emit, p_canjoin,
+             p_rowmap) = prefill
+            w = p_tokens.shape[1]
+            row_cache = replace(cache, tables=p_tables)
+            pos = p_base[:, None] + jnp.arange(w)[None, :]
+            logits, row_cache = decoder_forward(
+                cfg, params, p_tokens, row_cache, pos,
+                slot_offsets=p_base,
+                gather_positions=jnp.maximum(p_nvalid - 1, 0),
+                chunk_lens=p_nvalid,
+            )
+            cache = replace(cache, k=row_cache.k, v=row_cache.v)
+            key, sub = jax.random.split(key)
+            first_t, first_lp = sample_rows_with_logprobs(
+                logits, temps[p_rowmap], top_ps[p_rowmap], sub,
+                seeds=seeds[p_rowmap], steps=jnp.zeros_like(p_nvalid),
+                top_ks=top_ks[p_rowmap], active=p_emit)
+            # merge the wave into the decode state (pad slots drop):
+            # lengths advance for EVERY prefill row, completing rows join
+            # with their first token pre-published — the on-device form
+            # of the epoch upload the chained path paid here
+            new_len = p_base + p_nvalid
+            row_lens = row_lens.at[p_rowmap].set(new_len, mode="drop")
+            hit_eos = (first_t[:, None] == eos[p_rowmap]).any(axis=1)
+            rem_after = remain[p_rowmap] - 1
+            join = p_emit & p_canjoin & ~hit_eos & (rem_after > 0)
+            toks = toks.at[p_rowmap].set(
+                jnp.where(p_emit, first_t, toks[p_rowmap]), mode="drop")
+            steps = steps.at[p_rowmap].set(
+                jnp.where(p_emit, 1, steps[p_rowmap]), mode="drop")
+            remain = remain.at[p_rowmap].set(
+                jnp.where(p_emit, rem_after, remain[p_rowmap]),
+                mode="drop")
+            active = active.at[p_rowmap].set(
+                jnp.where(p_emit, join, active[p_rowmap]), mode="drop")
+        if with_decode:
+            (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
+             steps, remain, key) = _decode_horizon_loop(
+                cfg, params, cache, toks, row_lens, active, temps,
+                top_ps, key, seeds, steps, top_ks, eos, remain, horizon)
+        else:
+            tok_block = jnp.zeros((r, horizon), jnp.int32)
+            lp_block = jnp.zeros((r, horizon), jnp.float32)
+            n_exec = jnp.asarray(0, jnp.int32)
+    return (first_t, first_lp, tok_block, lp_block, n_exec, cache, toks,
+            row_lens, active, steps, remain, key)
 
 
 class ServingEngine:
@@ -1633,12 +1757,13 @@ class ServingEngine:
                 self._fail_all(exc)
 
     def _step_once(self):
-        """Scheduler: three regimes.  Admission wave (any row prefilling)
-        → ``_mixed_step`` batches every prefill chunk into one device
-        program and chains the decode step onto the same tick; steady
-        state → the fused decode horizon (unchanged, bit-identical to
-        before); spec_k / pp engines keep the sequential one-row-one-chunk
-        admission path."""
+        """Scheduler: three regimes, ONE dispatch per tick.  Admission
+        wave (any row prefilling) → ``_mixed_step`` fuses every prefill
+        chunk, on-device first-token merge, and the decode step into the
+        single ``_ragged_tick_fn`` program; steady state → the fused
+        decode horizon through the SAME entry (bit-identical to the
+        historical ``_decode_multi_step``); spec_k / pp engines keep the
+        sequential one-row-one-chunk admission path."""
         self._drain_inbox()
         self._expire_deadlines()
         self.metrics["queue_depth"] = self.queue_depth
@@ -1673,23 +1798,23 @@ class ServingEngine:
         self._work.clear()
 
     def _mixed_step(self):
-        """One admission-wave tick: batched ragged prefill chunks for ALL
-        prefilling rows (one row-sliced device program, first tokens
-        sampled on device for completing prompts) chained with the fused
-        decode step for all active rows — replacing the sequential
-        one-row-one-chunk / decode alternation, which dispatched
-        O(rows x chunks) tiny programs and paid a host sampling round
-        trip plus a full block-table re-upload per chunk.
+        """One admission-wave tick = ONE device program
+        (``_ragged_tick_fn``): ragged prefill chunks for ALL prefilling
+        rows, on-device first-token sampling AND state merge for prompts
+        completing this tick, and the decode step for every active row —
+        all inside a single jitted entry, so a mixed tick pays one
+        dispatch and at most one blocking sync (completion ticks fetch
+        first tokens and the decode block from the same program).  The
+        JP106 trace gate locks the one-dispatch invariant; the chained
+        two-program tick survives only as the equivalence oracle.
 
         Budget split: the per-tick token budget divides across prefilling
         rows in a power-of-two per-row chunk width (so every joining row
-        advances every tick and the mixed program retraces at most once
-        per width), decode rows ride the ordinary [R, 1] decode program
-        on the same chained cache — one token per tick, the sequential
-        engine's exact pace and program, so their streams stay trivially
-        bit-identical.  Dispatches per tick: two, with at most one
-        blocking sync (the decode block; completion ticks add the
-        first-token fetch)."""
+        advances every tick and the tick program retraces at most once
+        per width), decode rows keep their [R, 1] step cost inside the
+        fused program — one token per tick, the sequential engine's exact
+        pace and loop body, so their streams stay trivially
+        bit-identical."""
         if not self._prefilling:
             return
         rows = sorted(r for r in self._prefilling
@@ -1721,10 +1846,10 @@ class ServingEngine:
                        np.int32)
         n_valid = np.zeros((p_b,), np.int32)
         emit = np.zeros((p_b,), bool)
-        temps = np.zeros((p_b,), np.float32)
-        top_ps = np.ones((p_b,), np.float32)
-        seeds = np.full((p_b,), -1, np.int32)
-        top_ks = np.zeros((p_b,), np.int32)
+        canjoin = np.ones((p_b,), bool)
+        # prefill slot -> engine row; pad slots carry R so their on-device
+        # state scatters DROP instead of touching row 0
+        rowmap = np.full((p_b,), self.ec.max_rows, np.int32)
         chunks: list[tuple[int, int, int]] = []  # (slot, row, n_i)
         for i, row in enumerate(rows):
             rem = self._prefilling[row]
@@ -1733,84 +1858,149 @@ class ServingEngine:
             if not self._ensure_pages(row, b + n_i):
                 self._finish(row, "error")  # pool exhausted mid-prefill
                 continue
-            req = self.rows[row]
             toks[i, :n_i] = rem[:n_i]
             base[i] = b
             n_valid[i] = n_i
             emit[i] = n_i == len(rem)      # prompt completes this tick
-            temps[i] = req.temperature
-            top_ps[i] = req.top_p
-            seeds[i] = -1 if req.seed is None else int(req.seed)
-            top_ks[i] = max(0, int(req.top_k or 0))
+            rowmap[i] = row
             chunks.append((i, row, n_i))
-        if chunks:
-            self._fault_point("mixed-step", rows=[r for _, r, _ in chunks])
-            cache = self._flush_dirty_tables()
-            full_tables = cache.tables
-            row_idx = np.zeros((p_b,), np.int32)
-            row_idx[:len(rows)] = rows
-            # slice the table view to the pages the batch actually uses
-            # (power-of-two bucketed): the jnp fallback gathers each row's
-            # whole table width per layer, so early chunks of a long
-            # prompt would otherwise pay the full-capacity gather; dropped
-            # positions are exactly-masked (zero-probability) slots, so
-            # chunk values stay bitwise identical.  Narrow tables skip the
-            # slicing — the gather saving there is smaller than the cost
-            # of extra program traces per width bucket
-            if self.ec.max_pages > 8:
-                ps = self.ec.page_size
-                maxp_used = max(-(-(int(base[i]) + int(n_valid[i])) // ps)
-                                for i, _, _ in chunks)
-                maxp_b = min(1 << (max(maxp_used, 1) - 1).bit_length(),
-                             self.ec.max_pages)
-            else:
-                maxp_b = self.ec.max_pages
-            sliced = cache.with_tables(
-                full_tables[h2d(row_idx)][:, :maxp_b])
-            nxt, lp, out, self.key = _mixed_prefill_fn(
-                self.cfg, self.params, sliced, h2d(toks),
-                h2d(base), h2d(n_valid), h2d(emit),
-                h2d(temps), h2d(top_ps), self.key,
-                h2d(seeds), h2d(top_ks), mesh=self.mesh)
-            self.cache = out.with_tables(full_tables)
-            # advance bookkeeping; completed prompts run the shared
-            # completion path (_finish_prompt) once their token arrives
-            completing: list[tuple[int, int]] = []   # (slot, row)
-            for i, row, n_i in chunks:
-                self.row_lens[row] += n_i
-                rem = self._prefilling[row]
-                if n_i == len(rem):
-                    self._prefilling.pop(row)
-                    completing.append((i, row))
-                else:
-                    self._prefilling[row] = rem[n_i:]
-            self.metrics["mixed_steps"] += 1
-            self.metrics["mixed_prefill_tokens"] += sum(
-                n for _, _, n in chunks)
-            self.metrics["prefill_tokens_per_step"] = round(
-                self.metrics["mixed_prefill_tokens"]
-                / self.metrics["mixed_steps"], 2)
-            self.metrics["pages_in_use"] = self.alloc.pages_in_use
-            # pure-chunk ticks are NOT an epoch: the decode program masks
-            # prefilling rows and routes their writes to the scratch page,
-            # so their stale device-side lengths are harmless — only a
-            # completion (row joins decode) re-uploads row state
-            if completing:
-                self._dirty = True
-                self._fault_point("sample",
-                                  rows=[row for _, row in completing])
-                t0 = time.perf_counter()
-                # jaxlint: disable=JL002 -- designed sync: first tokens of prompts completing this mixed tick must reach the host to emit; counted via _count_sync
-                nxt, lp = d2h(nxt), d2h(lp)
-                self._count_sync(time.perf_counter() - t0)
-                for i, row in completing:
-                    self._finish_prompt(row, int(nxt[i]), float(lp[i]))
-        # decode rows (including prompts that just completed) ride the
-        # same tick through the ordinary fused decode entry — during a
-        # wave it runs h=1, one token per row per tick
+        if not chunks:
+            active = self._active_mask()
+            if active.any():
+                self._horizon_step(active)
+            return
+        # a completing row's first decode step runs INSIDE this same
+        # program and writes KV at slot b+n_i — back it now, or the row
+        # sits the decode stage out and finishes 'length' after its
+        # first token (the old second dispatch's dry-pool behaviour,
+        # decided pre-dispatch).  This runs AFTER every row's chunk
+        # pages are ensured: under pool pressure the extra decode page
+        # must never starve a later row's prefill chunk (which would
+        # turn that request's graceful progress into a hard 'error')
+        for i, row, n_i in chunks:
+            if emit[i]:
+                canjoin[i] = self._ensure_pages(
+                    row, int(base[i]) + n_i + 1, req=self.rows[row])
+        # decode participants need their next KV slot backed BEFORE the
+        # single dispatch (the old second dispatch's pre-allocation): a
+        # row the pool cannot back finishes 'length' here and is
+        # excluded from the uploaded active mask.  (No horizon clamp
+        # like _horizon_step's: at want=1 a failed ensure always means
+        # zero backed slots remain.)
         active = self._active_mask()
-        if active.any():
-            self._horizon_step(active)
+        for i in range(len(self.rows)):
+            if not active[i]:
+                continue
+            if not self._ensure_pages(i, int(self.row_lens[i]) + 1):
+                self._finish(i, "length")
+                active[i] = False
+        # pure-chunk ticks with nothing decoding skip the decode stage
+        # entirely (statically): no all-masked forward, and the key chain
+        # advances only by the prefill split — the chained path's exact
+        # behaviour when it skipped the second dispatch.  ONE known
+        # deviation: emit is decidable pre-dispatch but the first token's
+        # EOS/budget fate is not, so a completion whose row dies at its
+        # first token (no other rows active) still runs an all-dead
+        # decode stage and splits the key once more than the chained
+        # path did.  Greedy and seeded streams are untouched (seeded
+        # rows key on fold_in(seed, step), never the engine chain); only
+        # unseeded temperature>0 draws after that corner differ, and
+        # those carry no reproducibility contract (same distribution,
+        # different stream).
+        with_decode = bool(active.any() or emit.any())
+        self._fault_point("mixed-step", rows=[r for _, r, _ in chunks])
+        # decode participants = rows already decoding PLUS completions
+        # that can join the decode stage this tick: a request-scoped
+        # fault at this site must fire on the tick its request first
+        # decodes (the chained path fired it post-completion), or the
+        # rollback contract would let its first tokens commit
+        decode_rows = [i for i in range(len(self.rows)) if active[i]]
+        decode_rows += [row for s, row, _ in chunks
+                        if emit[s] and canjoin[s]]
+        if with_decode and decode_rows:
+            self._fault_point("decode-dispatch", rows=decode_rows)
+        cache = self._flush_dirty_tables()
+        full_tables = cache.tables
+        row_idx = np.zeros((p_b,), np.int32)
+        row_idx[:len(rows)] = rows
+        # slice the table view to the pages the batch actually uses
+        # (power-of-two bucketed): the jnp fallback gathers each row's
+        # whole table width per layer, so early chunks of a long
+        # prompt would otherwise pay the full-capacity gather; dropped
+        # positions are exactly-masked (zero-probability) slots, so
+        # chunk values stay bitwise identical.  Narrow tables skip the
+        # slicing — the gather saving there is smaller than the cost
+        # of extra program traces per width bucket
+        if self.ec.max_pages > 8:
+            ps = self.ec.page_size
+            maxp_used = max(-(-(int(base[i]) + int(n_valid[i])) // ps)
+                            for i, _, _ in chunks)
+            maxp_b = min(1 << (max(maxp_used, 1) - 1).bit_length(),
+                         self.ec.max_pages)
+        else:
+            maxp_b = self.ec.max_pages
+        p_tables = full_tables[h2d(row_idx)][:, :maxp_b]
+        dev = self._sync_device_state()
+        prefill = (h2d(toks), p_tables, h2d(base), h2d(n_valid),
+                   h2d(emit), h2d(canjoin), h2d(rowmap))
+        (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
+         dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
+         dev["remain"], self.key) = _ragged_tick_fn(
+            self.cfg, self.params, self.cache, dev["toks"],
+            dev["row_lens"], dev["active"], dev["temps"], dev["top_ps"],
+            self.key, dev["seeds"], dev["steps"], dev["top_ks"],
+            dev["eos"], dev["remain"], prefill=prefill, horizon=1,
+            with_decode=with_decode, mesh=self.mesh)
+        # advance bookkeeping; completed prompts run the shared
+        # completion path (_finish_prompt) once their token arrives
+        completing: list[tuple[int, int]] = []   # (slot, row)
+        for i, row, n_i in chunks:
+            self.row_lens[row] += n_i
+            rem = self._prefilling[row]
+            if n_i == len(rem):
+                self._prefilling.pop(row)
+                completing.append((i, row))
+            else:
+                self._prefilling[row] = rem[n_i:]
+        self.metrics["mixed_steps"] += 1
+        self.metrics["mixed_prefill_tokens"] += sum(
+            n for _, _, n in chunks)
+        self.metrics["prefill_tokens_per_step"] = round(
+            self.metrics["mixed_prefill_tokens"]
+            / self.metrics["mixed_steps"], 2)
+        self.metrics["pages_in_use"] = self.alloc.pages_in_use
+        if not with_decode:
+            # pure-chunk tick, nothing decoding: no sync at all — the
+            # program advanced every prefill row's device length in
+            # place, so this is not even an epoch
+            return
+        if completing:
+            self._dirty = True
+            self._fault_point("sample",
+                              rows=[row for _, row in completing])
+        t0 = time.perf_counter()
+        if completing:
+            # jaxlint: disable=JL002 -- designed sync: first tokens of prompts completing this tick must reach the host to emit; rides THE one per-tick sync, counted via _count_sync
+            nxt, lp = d2h(first_t), d2h(first_lp)
+        tok_np = d2h(tok_block)  # jaxlint: disable=JL002 -- THE per-tick designed sync: one blocking materialization for the whole fused tick
+        lp_np = d2h(lp_block)  # jaxlint: disable=JL002 -- rides THE per-tick sync above (same dispatched program)
+        executed = int(d2h(n_exec))  # jaxlint: disable=JL002 -- rides THE per-tick sync: 0 only when no row decoded
+        self._count_sync(time.perf_counter() - t0)
+        for i, row in completing:
+            self._finish_prompt(row, int(nxt[i]), float(lp[i]))
+            if not canjoin[i] and self.rows[row] is not None:
+                # the pool could not back its decode slot: the program
+                # kept it out of the decode stage; finish like the old
+                # second dispatch's dry-pool path
+                self._finish(row, "length")
+        self.metrics["steps"] += executed
+        self.metrics["decode_horizon_effective"] = 1
+        # the drain walk covers the decode participants: rows already
+        # decoding plus completions that joined on device; rows finished
+        # above (first-token EOS/budget/length) are None and skip
+        self._drain_block(tok_np, lp_np, self._active_mask(), executed)
+        self.metrics["tokens_per_sync"] = round(
+            self.metrics["tokens"] / max(self.metrics["host_syncs"], 1), 2)
 
     def _horizon_step(self, active: np.ndarray):
         """Fused decode: up to ``decode_horizon`` decode+sample steps in one
@@ -1874,14 +2064,20 @@ class ServingEngine:
             self._dirty = True
             executed = 1
         else:
-            (tok_block, lp_block, n_exec, self.cache, dev["toks"],
+            # the steady-state tick is the SAME single jitted entry the
+            # mixed tick uses, with no prefill block: one program either
+            # way, which is what lets JP106 pin the tick dispatch count
+            # to exactly 1 (the decode stage traces _decode_horizon_loop,
+            # so output is bit-identical to the historical
+            # _decode_multi_step program)
+            (_, _, tok_block, lp_block, n_exec, self.cache, dev["toks"],
              dev["row_lens"], dev["active"], dev["steps"], dev["remain"],
-             self.key) = _decode_multi_step(
+             self.key) = _ragged_tick_fn(
                 self.cfg, self.params, self.cache, dev["toks"],
                 dev["row_lens"], dev["active"], dev["temps"],
                 dev["top_ps"], self.key, dev["seeds"], dev["steps"],
                 dev["top_ks"], dev["eos"], dev["remain"],
-                horizon=h, mesh=self.mesh)
+                prefill=None, horizon=h, mesh=self.mesh)
             # the returned cache owns the (donated) tables buffer now
         t0 = time.perf_counter()
         tok_block = d2h(tok_block)   # jaxlint: disable=JL002 -- THE per-horizon designed sync: h tokens per host round trip, counted via _count_sync

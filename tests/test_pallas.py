@@ -258,13 +258,15 @@ def test_paged_decode_kernel_matches_gather(monkeypatch):
 
 
 def test_serving_engine_uses_paged_kernel(monkeypatch):
-    """End-to-end: the engine's decode step through the paged kernel
-    (interpret mode) matches plain generate."""
+    """End-to-end: the engine's tick through the ragged paged-attention
+    superkernel (interpret mode) matches plain generate — both the
+    prefill-chunk (C>1) and decode (C=1) row shapes route through the ONE
+    kernel family."""
     import numpy as np
 
     from ipex_llm_tpu.generation import GenerationConfig, generate
     from ipex_llm_tpu.ops import dispatch
-    from ipex_llm_tpu.ops.pallas import paged_attention
+    from ipex_llm_tpu.ops.pallas import ragged_paged_attention
     from ipex_llm_tpu.serving.engine import (
         EngineConfig,
         Request,
@@ -284,20 +286,14 @@ def test_serving_engine_uses_paged_kernel(monkeypatch):
     want_toks = list(want.sequences[0, len(prompt):len(prompt) + 6])
 
     calls = {"n": 0, "prefill": 0}
-    real = paged_attention.paged_decode_sdpa
-    real_prefill = paged_attention.paged_prefill_sdpa
+    real = ragged_paged_attention.ragged_paged_sdpa
 
-    def counted(*a, **kw):
-        calls["n"] += 1
-        return real(*a, **kw)
+    def counted(q, *a, **kw):
+        calls["prefill" if q.shape[1] > 1 else "n"] += 1
+        return real(q, *a, **kw)
 
-    def counted_prefill(*a, **kw):
-        calls["prefill"] += 1
-        return real_prefill(*a, **kw)
-
-    monkeypatch.setattr(paged_attention, "paged_decode_sdpa", counted)
-    monkeypatch.setattr(paged_attention, "paged_prefill_sdpa",
-                        counted_prefill)
+    monkeypatch.setattr(ragged_paged_attention, "ragged_paged_sdpa",
+                        counted)
     monkeypatch.setenv("IPEX_LLM_TPU_FORCE_PALLAS", "1")
     dispatch.clear_cache()
     try:

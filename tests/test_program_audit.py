@@ -334,10 +334,11 @@ def test_jp106_fires_on_a_third_dispatch_sneaking_in():
     assert any("above the gate" in f.message for f in found)
 
 
-def test_real_mixed_tick_issues_two_dispatches():
-    # the serving_bench row stamps this number; the superkernel roadmap
-    # item tightens it to 1
-    assert mixed_tick_dispatch_count() == 2
+def test_real_mixed_tick_issues_one_dispatch():
+    # the serving_bench row stamps this number; the ragged superkernel
+    # tick (_ragged_tick_fn) drove it from 2 to exactly 1, and JP106
+    # keeps it there
+    assert mixed_tick_dispatch_count() == 1
 
 
 # --------------------------------------------------------------------------

@@ -339,6 +339,12 @@ def test_prefix_cache_sharing(cfg_params):
         eng.stop()
 
 
+# slow tier: the 16-row wave compiles every (P, W) tick-program variant
+# of the fused one-dispatch tick — the most compile-dominated test in the
+# module (the behaviors it stresses stay fast-tier covered:
+# test_concurrent_requests_match_single, test_serving_mixed's threaded
+# e2e + contention, test_serving_horizon's page-pressure clamp)
+@pytest.mark.slow
 def test_sixteen_concurrent_streams(cfg_params):
     """>=16 concurrent mixed-length streams all complete correctly and
     per-token decode latency stays within ~2x of a single stream."""
@@ -611,6 +617,11 @@ def test_speculative_per_request_spec_k(cfg_params, monkeypatch):
     assert eng.metrics["spec_steps"] - steps_solo <= 5, eng.metrics
 
 
+# slow tier: long churn over an overcommitted pool — compile-dominated
+# under the fused tick's (P, W) variants; fast contention coverage rides
+# test_serving_mixed::test_mixed_respects_page_pool_contention and
+# test_serving_horizon::test_horizon_shortens_under_page_pressure
+@pytest.mark.slow
 def test_pool_contention_under_load(cfg_params):
     """VERDICT r3 weak #9: drive the paged pool into contention — more
     concurrent demand than pages — and require every request to either

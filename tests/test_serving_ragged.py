@@ -1,0 +1,432 @@
+"""Ragged paged-attention superkernel tick (ONE dispatch per engine tick).
+
+The contract under test: every engine tick — admission wave or steady
+state — lowers to the SINGLE jitted entry ``_ragged_tick_fn`` (ragged
+prefill + on-device first-token merge + fused decode horizon), and the
+resulting token AND logprob streams are bit-identical to the sequential
+engine and to the chained two-program tick it replaced, under bf16 AND
+fp8 KV storage.  Plus: the dead-row scratch-route regression (stale
+device lens on masked rows must never corrupt live pages), the tightened
+host-sync budget, and the measured-ladder dispatch policy
+(ops/dispatch.py): on CPU-interpret environments the recorded microbench
+ladder must provably select the faster (XLA) backend.
+"""
+
+import json
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from ipex_llm_tpu.hostutil import h2d
+from ipex_llm_tpu.kv import PagedKVCache
+from ipex_llm_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+    _decode_multi_step,
+    _mixed_prefill_fn,
+    _ragged_tick_fn,
+    stream_tokens,
+)
+from tests.test_decoder import rand_params, tiny_cfg
+from tests.test_serving import _assert_greedy_stream
+from tests.test_serving_mixed import _drive
+
+RNG = np.random.default_rng(47)
+
+EC = dict(max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=32)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=127, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+def _wave_specs(cfg):
+    p1 = list(RNG.integers(0, cfg.vocab_size, 40))
+    p2 = list(RNG.integers(0, cfg.vocab_size, 70))
+    p3 = list(RNG.integers(0, cfg.vocab_size, 24))
+    return [
+        dict(prompt_ids=p1, max_new_tokens=10),
+        dict(prompt_ids=p2, max_new_tokens=10, temperature=0.8, top_p=0.9,
+             top_k=40, seed=321),
+        dict(prompt_ids=p3, max_new_tokens=10),
+    ]
+
+
+# -- bit-identity through the superkernel tick ------------------------------
+#
+# Tier note: the engine routes EVERY tick through _ragged_tick_fn now, so
+# the fast tier's existing suites already gate bit-identity through the
+# superkernel (test_serving_mixed: mixed==sequential bf16 + first-token
+# EOS + contention; test_serving_horizon: H8==H1; test_serving_kv_storage:
+# both under fp8).  The re-statements below are the ragged suite's own
+# end-to-end forms — slow tier, where the 870 s tier-1 wall stays intact.
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv", ("bf16", "fp8"))
+def test_mixed_bit_identical_to_sequential(cfg_params, kv):
+    """Staggered admissions through the one-dispatch tick emit the exact
+    token and logprob streams of the sequential chunk-then-decode engine
+    — greedy, seeded sampled, and a mid-wave finish — under both KV
+    storages."""
+    cfg, params = cfg_params
+    specs = _wave_specs(cfg)
+    schedule = lambda: {0: [Request(**specs[0])], 1: [Request(**specs[1])],
+                        3: [Request(**specs[2])]}
+
+    sched_m = schedule()
+    eng_m = ServingEngine(cfg, params, EngineConfig(kv_storage=kv, **EC))
+    streams_m = _drive(eng_m, sched_m)
+    sched_s = schedule()
+    eng_s = ServingEngine(
+        cfg, params, EngineConfig(kv_storage=kv, step_token_budget=0, **EC))
+    streams_s = _drive(eng_s, sched_s)
+
+    assert eng_m.metrics["mixed_steps"] > 0
+    assert streams_m == streams_s
+    reqs_m = [r for rs in sched_m.values() for r in rs]
+    reqs_s = [r for rs in sched_s.values() for r in rs]
+    for a, b in zip(reqs_m, reqs_s):
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_array_equal(
+            np.asarray(a.logprobs, np.float32),
+            np.asarray(b.logprobs, np.float32))
+    if kv == "bf16":
+        _assert_greedy_stream(cfg, params, specs[0]["prompt_ids"],
+                              streams_m[0])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv", ("bf16", "fp8"))
+def test_h8_bit_identical_to_h1(cfg_params, kv):
+    """H=8 steady-state decode through the superkernel entry emits H=1's
+    exact streams (tokens AND logprobs), bf16 and fp8."""
+    cfg, params = cfg_params
+    specs = _wave_specs(cfg)
+
+    def run(h):
+        sched = {0: [Request(**s) for s in specs]}
+        eng = ServingEngine(cfg, params, EngineConfig(
+            kv_storage=kv, decode_horizon=h, **EC))
+        streams = _drive(eng, sched)
+        return streams, [r.logprobs for rs in sched.values() for r in rs], \
+            eng.metrics
+
+    s1, lp1, _ = run(1)
+    s8, lp8, m8 = run(8)
+    assert s8 == s1
+    for a, b in zip(lp8, lp1):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    assert m8["decode_horizon_effective"] == 8
+
+
+@pytest.mark.slow
+def test_first_token_eos_finishes_inside_the_tick(cfg_params):
+    """A row whose FIRST sampled token is its EOS finishes 'stop' from
+    inside the fused tick (the on-device join must keep it OUT of the
+    decode stage) while another row keeps prefilling — and the sequential
+    engine agrees on every stream."""
+    cfg, params = cfg_params
+    p_short = list(RNG.integers(0, cfg.vocab_size, 20))
+    p_long = list(RNG.integers(0, cfg.vocab_size, 60))
+    probe = ServingEngine(cfg, params, EngineConfig(**EC))
+    (ptoks,) = _drive(probe, {0: [Request(prompt_ids=p_short,
+                                          max_new_tokens=2)]})
+    eos = int(ptoks[0])
+
+    def schedule():
+        return {0: [Request(prompt_ids=p_long, max_new_tokens=8)],
+                1: [Request(prompt_ids=p_short, max_new_tokens=8,
+                            eos_token_id=(eos,))]}
+
+    sched_m = schedule()
+    streams_m = _drive(ServingEngine(cfg, params, EngineConfig(**EC)),
+                       sched_m)
+    sched_s = schedule()
+    streams_s = _drive(
+        ServingEngine(cfg, params, EngineConfig(step_token_budget=0, **EC)),
+        sched_s)
+    assert streams_m == streams_s
+    short_m = [r for rs in sched_m.values() for r in rs][1]
+    assert short_m.finish_reason == "stop"
+    assert streams_m[1] == [eos]
+
+
+@pytest.mark.slow
+def test_pool_contention_clamp(cfg_params):
+    """Overcommitted pool through the one-dispatch tick: every request
+    completes correctly or fails loudly ('length'/'error'), the clamp
+    counters fire instead of silent corruption, and the pool drains."""
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, 30 + 10 * i))
+               for i in range(4)]
+    reqs = [Request(prompt_ids=p, max_new_tokens=12) for p in prompts]
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=16, pool_pages=18,
+        prefill_bucket=32))
+    streams = _drive(eng, {0: reqs})
+    served = 0
+    for p, r, s in zip(prompts, reqs, streams):
+        if r.finish_reason == "length" and len(s) == 12:
+            _assert_greedy_stream(cfg, params, p, s)
+            served += 1
+        else:
+            assert r.finish_reason in ("length", "error"), r.finish_reason
+    assert served >= 1, [r.finish_reason for r in reqs]
+    for pid in range(1, eng.alloc.n_pages):
+        refs = int(eng.alloc.ref[pid])
+        cached = set(eng.alloc.prefix.values())
+        assert refs == 0 or (pid in cached and refs == 1), (pid, refs)
+
+
+def test_one_sync_per_tick_tier1(cfg_params):
+    """Tier-1 dispatch-economics guard, tightened for the superkernel: a
+    simultaneous 3-row wave pays ONE blocking sync per tick that emits
+    (completion tick + per-decode-tick), and pure-chunk ticks pay none —
+    the two-dispatch tick's separate first-token sync is gone."""
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, 64)) for _ in range(3)]
+    reqs = [Request(prompt_ids=p, max_new_tokens=4) for p in prompts]
+    eng = ServingEngine(cfg, params, EngineConfig(**EC))
+    _drive(eng, {0: reqs})
+    m = eng.metrics
+    # 192 prompt tokens / (3 rows x 8-token pow2 share) = 8 prefill ticks
+    # (7 pure-chunk: no sync) + 1 completion tick (one fused sync) +
+    # 3 steady decode ticks (one sync each) = 4 blocking syncs
+    assert m["mixed_steps"] <= 10, m
+    assert m["host_syncs"] <= 5, m
+    assert m["tokens_per_sync"] >= 2.0, m
+
+
+# -- the superkernel program == the chained two-program tick ----------------
+
+def _random_pool_state(cfg, kv: str, seed: int = 0):
+    """A filled 4-row paged pool with rows 0/1 mid-decode, row 2 about to
+    complete its prompt, row 3 idle — the canonical mixed-tick state."""
+    rng = np.random.default_rng(seed)
+    r, ps, maxp, pages = 4, 16, 4, 24
+    cache = PagedKVCache.init(
+        cfg.num_layers, pages, r, maxp, cfg.num_kv_heads, ps,
+        cfg.head_dim, v_head_dim=cfg.v_dim, storage=kv)
+    tables = np.asarray(
+        1 + np.arange(r * maxp, dtype=np.int32).reshape(r, maxp))
+    pool_shape = cache.k.shape
+    kpool = jnp.asarray(rng.standard_normal(pool_shape),
+                        jnp.float32).astype(cache.k.dtype)
+    vpool = jnp.asarray(rng.standard_normal(cache.v.shape),
+                        jnp.float32).astype(cache.v.dtype)
+    cache = PagedKVCache(k=kpool, v=vpool, tables=jnp.asarray(tables),
+                         length=cache.length, storage=kv)
+    state = dict(
+        toks=np.asarray([5, 9, 0, 0], np.int32),
+        row_lens=np.asarray([20, 9, 8, 0], np.int32),
+        active=np.asarray([True, True, False, False]),
+        temps=np.asarray([0.0, 0.8, 0.5, 0.0], np.float32),
+        top_ps=np.asarray([1.0, 0.9, 0.95, 1.0], np.float32),
+        seeds=np.asarray([-1, 7, 3, -1], np.int32),
+        steps=np.asarray([2, 1, 0, 0], np.int32),
+        top_ks=np.asarray([0, 5, 4, 0], np.int32),
+        eos=np.asarray([[1, -1], [1, -1], [1, -1], [1, -1]], np.int32),
+        remain=np.asarray([4, 5, 6, 0], np.int32),
+    )
+    # prefill block: row 2 completes a 5-token chunk this tick; pad slot
+    # carries base past the table width (scratch) and rowmap=R (dropped)
+    w = 8
+    p_tokens = np.zeros((2, w), np.int32)
+    p_tokens[0, :5] = rng.integers(0, cfg.vocab_size, 5)
+    prefill = dict(
+        p_tokens=p_tokens,
+        p_tables=tables[[2, 0]],            # pad slot gathers row 0 (old
+        p_base=np.asarray([8, maxp * ps], np.int32),   # row_idx=0 policy)
+        p_nvalid=np.asarray([5, 0], np.int32),
+        p_emit=np.asarray([True, False]),
+        p_canjoin=np.asarray([True, True]),
+        p_rowmap=np.asarray([2, 4], np.int32),
+    )
+    return cache, state, prefill
+
+
+def _dev_state(state):
+    return {k: h2d(v) for k, v in state.items()}
+
+
+@pytest.mark.parametrize("kv", [
+    "bf16",
+    # the fp8 form re-proves the same program pair at twice the compile
+    # cost; slow tier keeps the tier-1 wall
+    pytest.param("fp8", marks=pytest.mark.slow),
+])
+def test_ragged_tick_equals_chained_programs(cfg_params, kv):
+    """THE oracle: one `_ragged_tick_fn` dispatch == `_mixed_prefill_fn`
+    chained with `_decode_multi_step` on identical state — first tokens,
+    decode blocks, logprobs, the advanced device state, the key chain,
+    and every byte of the KV pool."""
+    cfg, params = cfg_params
+    key = jax.random.PRNGKey(11)
+
+    # --- fused single-dispatch tick -----------------------------------
+    cache_a, st, pf = _random_pool_state(cfg, kv)
+    dev = _dev_state(st)
+    prefill = (h2d(pf["p_tokens"]), h2d(pf["p_tables"]),
+               h2d(pf["p_base"]), h2d(pf["p_nvalid"]), h2d(pf["p_emit"]),
+               h2d(pf["p_canjoin"]), h2d(pf["p_rowmap"]))
+    (first_t, first_lp, tok_a, lp_a, n_a, cache_a, toks_a, lens_a,
+     act_a, steps_a, rem_a, key_a) = _ragged_tick_fn(
+        cfg, params, cache_a, dev["toks"], dev["row_lens"], dev["active"],
+        dev["temps"], dev["top_ps"], key, dev["seeds"], dev["steps"],
+        dev["top_ks"], dev["eos"], dev["remain"], prefill=prefill,
+        horizon=1, with_decode=True)
+
+    # --- chained two-program tick (the pre-superkernel path) ----------
+    cache_b, st, pf = _random_pool_state(cfg, kv)
+    dev = _dev_state(st)
+    # the old host built [P] sampling-param slices for the prefill batch
+    rm = np.clip(pf["p_rowmap"], 0, 3)
+    nxt, lp, cache_b, key_b = _mixed_prefill_fn(
+        cfg, params, cache_b.with_tables(h2d(pf["p_tables"])),
+        h2d(pf["p_tokens"]), h2d(pf["p_base"]), h2d(pf["p_nvalid"]),
+        h2d(pf["p_emit"]), h2d(st["temps"][rm]), h2d(st["top_ps"][rm]),
+        key, h2d(st["seeds"][rm]), h2d(st["top_ks"][rm]))
+    cache_b = cache_b.with_tables(h2d(np.asarray(
+        1 + np.arange(16, dtype=np.int32).reshape(4, 4))))
+    nxt, lp = np.asarray(nxt), np.asarray(lp)
+    # the old host merge: completing row 2 joins decode with its first
+    # token published (toks/steps/remain/active), lens advanced
+    first = int(nxt[0])
+    st["row_lens"][2] = 8 + 5
+    st["toks"][2] = first
+    st["steps"][2] = 1
+    st["remain"][2] -= 1
+    st["active"][2] = (first not in st["eos"][2]) and st["remain"][2] > 0
+    dev = _dev_state(st)
+    (tok_b, lp_b, n_b, cache_b, toks_b, lens_b, act_b, steps_b, rem_b,
+     key_b) = _decode_multi_step(
+        cfg, params, cache_b, dev["toks"], dev["row_lens"], dev["active"],
+        dev["temps"], dev["top_ps"], key_b, dev["seeds"], dev["steps"],
+        dev["top_ks"], dev["eos"], dev["remain"], horizon=1)
+
+    # --- bitwise equivalence ------------------------------------------
+    np.testing.assert_array_equal(np.asarray(first_t)[:1], nxt[:1])
+    np.testing.assert_array_equal(np.asarray(first_lp, np.float32)[:1],
+                                  lp.astype(np.float32)[:1])
+    np.testing.assert_array_equal(np.asarray(tok_a), np.asarray(tok_b))
+    np.testing.assert_array_equal(np.asarray(lp_a, np.float32),
+                                  np.asarray(lp_b, np.float32))
+    for name, a, b in (("toks", toks_a, toks_b), ("lens", lens_a, lens_b),
+                       ("active", act_a, act_b),
+                       ("steps", steps_a, steps_b), ("rem", rem_a, rem_b),
+                       ("key", key_a, key_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+    # every LIVE byte of the pool: the superkernel's chunk scatter +
+    # decode write == the chained path's.  Excluded by contract: the
+    # scratch page 0 (dead/pad writes route there) and row 2's right-pad
+    # slack written this tick (slots past its decode write — layer>0 pad
+    # K/V depends on pad-query attention, which the tighter chunk_lens
+    # bound legitimately changes; those slots are overwritten before any
+    # valid query can see them, which the masked equality below proves
+    # for every other byte, fp8 e5m2 codes included).
+    live = np.ones((cache_a.k.shape[1], cache_a.k.shape[3]), bool)
+    live[0] = False                    # scratch page
+    live[9, 14:] = False               # row 2's pad slack after its
+    #                                    decode write at slot 13
+    mask = live[None, :, None, :, None]
+    for ca, cb in ((cache_a.k, cache_b.k), (cache_a.v, cache_b.v)):
+        a = np.asarray(ca.astype(jnp.float32))
+        b = np.asarray(cb.astype(jnp.float32))
+        np.testing.assert_array_equal(np.where(mask, a, 0.0),
+                                      np.where(mask, b, 0.0))
+
+
+def test_stale_device_lens_cannot_corrupt_neighbors(cfg_params):
+    """Regression (the PR 2 scratch-route rule, carried to the ragged
+    tick): a masked/dead row whose DEVICE row_lens is stale — pointing
+    into a LIVE row's allocated pages — must route its decode KV write to
+    the scratch page.  After the tick, no page except scratch (page 0)
+    and the live row's own write slot may change."""
+    cfg, params = cfg_params
+    cache, st, _ = _random_pool_state(cfg, "bf16", seed=3)
+    # row 1 is DEAD this tick but its stale device len points straight
+    # into row 0's history (row 0's pages are 1..4, slots 0..63)
+    st["active"] = np.asarray([True, False, False, False])
+    st["row_lens"] = np.asarray([20, 10, 0, 0], np.int32)
+    k_before = np.asarray(cache.k.astype(jnp.float32)).copy()
+    dev = _dev_state(st)
+    (_, _, _, _, _, cache, *_rest) = _ragged_tick_fn(
+        cfg, params, cache, dev["toks"], dev["row_lens"], dev["active"],
+        dev["temps"], dev["top_ps"], jax.random.PRNGKey(0), dev["seeds"],
+        dev["steps"], dev["top_ks"], dev["eos"], dev["remain"],
+        prefill=None, horizon=1, with_decode=True)
+    k_after = np.asarray(cache.k.astype(jnp.float32))
+    ps = 16
+    # row 0 wrote exactly its slot 20 -> page 1+20//16 = page 2, offset 4
+    changed = np.argwhere(
+        (k_before != k_after).any(axis=(0, 2, 4)))  # [page, slot] pairs
+    assert len(changed), "the live row must have written its slot"
+    for page, slot in changed:
+        assert page == 0 or (page == 1 + 20 // ps and slot == 20 % ps), (
+            f"page {page} slot {slot} corrupted by a dead row's stale len")
+
+
+# -- measured-ladder dispatch policy ----------------------------------------
+
+def test_dispatch_policy_selects_faster_backend_from_ladder(monkeypatch):
+    """On this CPU-interpret environment the recorded ladder (BENCH_r05's
+    interpret-vs-XLA rows) must provably select the XLA backend for every
+    paged/ragged decode op — and flipping the recorded numbers flips the
+    choice, proving the policy reads the data, not a hardcoded rule."""
+    from ipex_llm_tpu.ops import dispatch
+
+    monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+    dispatch.clear_cache()
+    try:
+        assert dispatch.backend_platform() == "cpu"
+        for op in ("ragged_attn", "ragged_attn_fp8", "decode_attn",
+                   "decode_attn_fp8", "paged_decode_attn"):
+            assert dispatch.ladder_prefers_pallas(op) is False
+            assert dispatch.use_pallas(op) is False
+        # an op the ladder is silent on falls back to the platform rule
+        assert dispatch.use_pallas("unmeasured_op") is False
+    finally:
+        dispatch.clear_cache()
+
+
+def test_dispatch_policy_is_data_driven(monkeypatch, tmp_path):
+    """A re-measured ladder (microbench collect() row dump) re-decides
+    the backend: recording pallas faster turns the kernel path on, and
+    the FORCE/DISABLE env overrides still outrank the data."""
+    from ipex_llm_tpu.ops import dispatch
+
+    rows = [{"op": "ragged_attn_r16_h32/8_s2048_w32_d128_bfloat16",
+             "pallas_us": 100.0, "xla_us": 300.0, "interpret": True},
+            {"op": "ragged_attn_r16_h32/8_s2048_w32_d128_float8_e5m2",
+             "pallas_us": 400.0, "xla_us": 300.0, "interpret": True}]
+    path = tmp_path / "ladder.json"
+    path.write_text(json.dumps(rows))
+    monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.setenv("IPEX_LLM_TPU_DISPATCH_LADDER", str(path))
+    dispatch.clear_cache()
+    try:
+        assert dispatch.use_pallas("ragged_attn") is True
+        assert dispatch.use_pallas("ragged_attn_fp8") is False
+        monkeypatch.setenv("IPEX_LLM_TPU_DISABLE_PALLAS", "1")
+        dispatch.clear_cache()
+        assert dispatch.use_pallas("ragged_attn") is False
+        monkeypatch.delenv("IPEX_LLM_TPU_DISABLE_PALLAS")
+        monkeypatch.setenv("IPEX_LLM_TPU_FORCE_PALLAS", "1")
+        dispatch.clear_cache()
+        assert dispatch.use_pallas("ragged_attn_fp8") is True
+    finally:
+        monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
+        monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+        dispatch.clear_cache()
