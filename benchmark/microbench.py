@@ -212,6 +212,50 @@ def bench_ragged_attn(r, hq, hkv, maxp, ps, d, width, dtype=jnp.bfloat16,
             "xla_gbs": round(nbytes / tr / 1e9, 1)}
 
 
+def bench_spec_verify(r, hq, hkv, maxp, ps, d, k_spec, dtype=jnp.bfloat16,
+                      iters=50):
+    """The on-device speculative verify shape: ONE [R, k+1] ragged
+    attention pass off the paged pool vs the k+1 sequential T=1 decode
+    passes the same tokens would cost without speculation.  Decode is
+    KV-bandwidth-bound, so the wide verify reads each row's pages once
+    where the sequential chain reads them k+1 times — the roofline
+    argument for the fused spec tick (a draft run that fully accepts
+    emits k+1 tokens for ~one pool sweep)."""
+    rng = np.random.default_rng(3)
+    cache, k, v = _paged_fixture(r, hkv, maxp, ps, d, dtype)
+    k1 = k_spec + 1
+    q_wide = jnp.asarray(rng.standard_normal((r, k1, hq, d)), jnp.bfloat16)
+    q_one = q_wide[:, :1]
+    base = maxp * ps - k1
+    kv_len = jnp.full((r,), maxp * ps, jnp.int32)
+    chunk = jnp.full((r,), k1, jnp.int32)
+    # bytes the sequential chain re-reads: k+1 sweeps of every row's pool
+    nbytes = 2 * r * maxp * ps * hkv * d * k.dtype.itemsize * k1
+
+    f_wide = jax.jit(lambda q, k, v: ragged_paged_sdpa(
+        q, k, v, cache.tables, kv_len, chunk))
+
+    def chain(q, k, v):
+        outs = []
+        for j in range(k1):
+            outs.append(paged_decode_sdpa(
+                q, k, v, cache.tables,
+                jnp.full((r,), base + j + 1, jnp.int32)))
+        return jnp.concatenate(outs, axis=1)
+    f_chain = jax.jit(chain)
+    tw = timeit(f_wide, q_wide, k, v, iters=iters)
+    tc = timeit(f_chain, q_one, k, v, iters=iters)
+    print(f"spec_verify R={r} Hq={hq} Hkv={hkv} S={maxp*ps} k={k_spec} "
+          f"D={d} {k.dtype}: wide {tw*1e6:8.1f}us "
+          f"({nbytes/tw/1e9:6.1f} GB/s eff) | chain {tc*1e6:8.1f}us "
+          f"({nbytes/tc/1e9:6.1f} GB/s)")
+    return {"op": (f"spec_verify_r{r}_h{hq}/{hkv}_s{maxp*ps}_k{k_spec}"
+                   f"_d{d}_{k.dtype.name}"),
+            "pallas_us": round(tw * 1e6, 1), "xla_us": round(tc * 1e6, 1),
+            "pallas_gbs": round(nbytes / tw / 1e9, 1),
+            "xla_gbs": round(nbytes / tc / 1e9, 1)}
+
+
 def collect(iters: int = 20) -> list[dict]:
     """Compact per-kernel summary for the BENCH artifact (fail-soft: an op
     whose kernel path is ineligible on this backend is skipped).
@@ -245,6 +289,11 @@ def collect(iters: int = 20) -> list[dict]:
              {"iters": iters}),
             (bench_ragged_attn, (16, 32, 8, 16, 128, 128, 32),
              {"dtype": jnp.float8_e5m2, "iters": iters}),
+            # speculative verify: one [R, k+1] pass vs k+1 decode passes
+            (bench_spec_verify, (16, 32, 8, 16, 128, 128, 4),
+             {"iters": iters}),
+            (bench_spec_verify, (16, 32, 8, 16, 128, 128, 4),
+             {"dtype": jnp.float8_e5m2, "iters": iters}),
         ]
     else:
         # interpret-mode shapes: small enough that the Pallas interpreter
@@ -264,6 +313,8 @@ def collect(iters: int = 20) -> list[dict]:
             (bench_ragged_attn, (2, 8, 4, 4, 32, 64, 8), {"iters": 2}),
             (bench_ragged_attn, (2, 8, 4, 4, 32, 64, 8),
              {"dtype": jnp.float8_e5m2, "iters": 2}),
+            # speculative verify (interpret record)
+            (bench_spec_verify, (2, 8, 4, 4, 32, 64, 3), {"iters": 2}),
         ]
     for fn, args, kw in jobs:
         try:
@@ -299,3 +350,6 @@ if __name__ == "__main__":
     # ragged superkernel batch (mixed decode + prefill rows), bf16 vs fp8
     bench_ragged_attn(16, 32, 8, 16, 128, 128, 32)
     bench_ragged_attn(16, 32, 8, 16, 128, 128, 32, jnp.float8_e5m2)
+    # speculative verify: one [R, k+1] pass vs the k+1-step decode chain
+    bench_spec_verify(16, 32, 8, 16, 128, 128, 4)
+    bench_spec_verify(16, 32, 8, 16, 128, 128, 4, jnp.float8_e5m2)

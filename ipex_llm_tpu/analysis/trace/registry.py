@@ -167,8 +167,15 @@ def _build_ragged_tick(pt):
                    _bool(p), _i32(p))
     else:   # steady-state form: pure decode horizon, no prefill block
         prefill = None
-    return base, {"prefill": prefill, "horizon": pt["horizon"],
-                  "with_decode": pt.get("wd", True), "mesh": None}
+    kw = {"prefill": prefill, "horizon": pt["horizon"],
+          "with_decode": pt.get("wd", True), "mesh": None}
+    if pt.get("spec"):
+        # speculative form: the device token-history ring (donated, the
+        # proposer's input) and the per-row traced draft-width caps ride
+        # as dynamic kwargs; spec_k/spec_ngram are statics
+        kw.update(hist=_i32(r, _MAXP * _PAGE), spec_ks=_i32(r),
+                  spec_k=pt["spec"], spec_ngram=3)
+    return base, kw
 
 
 def _build_mixed_prefill(pt):
@@ -278,10 +285,13 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             # THE tick program (JP106's one allowed dispatch): the grid
             # covers the steady-state form (width=0: pure decode horizon,
             # the _decode_multi_step-shaped program), the admission-wave
-            # form (prefill block at both pow2 chunk widths), AND the
+            # form (prefill block at both pow2 chunk widths), the
             # pure-chunk form (wd=False: prefill+merge with the decode
             # stage statically skipped — a distinct jit variant with the
-            # same donation contract), each over bf16 and fp8 pools
+            # same donation contract), AND the speculative forms
+            # (spec_k=4: on-device draft+verify+accept inside the horizon
+            # loop, steady-state at both horizons plus the admission-wave
+            # joiner tick), each over bf16 and fp8 pools
             name="serving.ragged_tick",
             fn=engine._ragged_tick_fn,
             build=_build_ragged_tick,
@@ -290,18 +300,25 @@ def real_registry() -> tuple[ProgramSpec, ...]:
                   + _grid(rows=(4,), width=(8, 128), horizon=(1,),
                           kv=kv_axis)
                   + _grid(rows=(4,), width=(8,), horizon=(1,),
-                          wd=(False,), kv=kv_axis)),
+                          wd=(False,), kv=kv_axis)
+                  + _grid(rows=(4,), width=(0,), horizon=(1, 8),
+                          spec=(4,), kv=kv_axis)
+                  + _grid(rows=(4,), width=(8,), horizon=(1,),
+                          spec=(4,), kv=kv_axis)),
             arg_names=("params", "cache", "toks", "row_lens", "active",
                        "temps", "top_ps", "key", "seeds", "steps",
                        "top_ks", "eos", "remain"),
+            # hist (spec forms only) is device-resident dead-after-call
+            # state like toks: the host rebinds _dev["hist"] per tick
             dead=frozenset({"cache", "toks", "row_lens", "active",
-                            "steps", "remain"}),
+                            "steps", "remain", "hist"}),
             # key is HELD (checkpoint-by-reference, the PR 6 rule);
             # sampling params/eos are epoch-held; the prefill block's
-            # arrays are fresh per-tick uploads, unlisted on purpose
+            # arrays and spec_ks are fresh per-tick uploads, unlisted on
+            # purpose
             held=frozenset({"params", "temps", "top_ps", "seeds",
                             "top_ks", "eos", "key"}),
-            max_lowerings=14,
+            max_lowerings=20,
         ),
         ProgramSpec(
             name="serving.decode_multi_step",

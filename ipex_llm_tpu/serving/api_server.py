@@ -434,6 +434,12 @@ class OpenAIServer:
             "rejected": m.get("rejected", 0),
             "timeouts": m.get("timeouts", 0),
         }
+        # speculative-decoding economics (spec_k > 0 engines): draft
+        # counts, the rolling accept rate the operator tunes spec_k /
+        # spec_ngram against, and tokens emitted per spec-tick dispatch —
+        # the on-device draft+verify+accept loop's amortization story
+        if self.engine.ec.spec_k > 0:
+            body["spec"] = self.engine.spec_stats()
         return web.json_response(body)
 
     async def metrics(self, request):
@@ -648,15 +654,22 @@ def main(argv=None):
                     help="whisper checkpoint enabling /v1/audio/transcriptions")
     ap.add_argument("--tensor-parallel-size", type=int, default=1,
                     help="serve under a tp mesh of this many chips")
-    ap.add_argument("--speculative", type=int, default=0, metavar="K",
-                    help="prompt-lookup speculative serving: verify K "
-                         "candidates per step (reference ipex_llm_worker "
-                         "`speculative` flag); acceptance rate in /metrics")
+    ap.add_argument("--spec-k", "--speculative", type=int, default=0,
+                    metavar="K", dest="spec_k",
+                    help="prompt-lookup speculative serving: draft, "
+                         "verify, and accept up to K candidates per row "
+                         "per decode step, ON DEVICE inside the fused "
+                         "tick (reference ipex_llm_worker `speculative` "
+                         "flag); composes with --decode-horizon; "
+                         "accept rate in /health's spec block")
+    ap.add_argument("--spec-ngram", type=int, default=3, metavar="N",
+                    help="longest n-gram the speculative lookup proposer "
+                         "matches against the row's token history")
     ap.add_argument("--decode-horizon", type=int, default=1, metavar="H",
                     help="fused multi-step decode: run H decode steps per "
                          "device program (one host sync per H tokens; "
-                         "streaming granularity becomes up to H tokens; "
-                         "mutually exclusive with --speculative)")
+                         "streaming granularity becomes up to H tokens, "
+                         "times K+1 with --spec-k)")
     ap.add_argument("--step-token-budget", type=int, default=None,
                     metavar="B",
                     help="mixed prefill+decode step: per-tick token budget "
@@ -698,7 +711,7 @@ def main(argv=None):
     srv = build_server(
         args.model, args.low_bit,
         EngineConfig(max_rows=args.max_rows, max_seq_len=args.max_seq_len,
-                     spec_k=args.speculative,
+                     spec_k=args.spec_k, spec_ngram=args.spec_ngram,
                      decode_horizon=args.decode_horizon,
                      step_token_budget=args.step_token_budget,
                      kv_storage=args.kv_storage,

@@ -87,16 +87,27 @@ class EngineConfig:
     prefill_bucket: int = 128   # chunked-prefill chunk length
     # speculative serving (reference ipex_llm_worker.py:57 `speculative`
     # load flag): >0 enables prompt-lookup speculative decode steps — each
-    # step verifies spec_k host-proposed n-gram candidates per row in ONE
-    # batched T=spec_k+1 forward.  Every position samples with the row's
-    # own params, so greedy AND sampled rows emit the accepted prefix with
+    # step verifies spec_k n-gram candidates per row in ONE batched
+    # T=spec_k+1 forward.  Every position samples with the row's own
+    # params, so greedy AND sampled rows emit the accepted prefix with
     # the plain engine's distribution (seeded rows bit-identically; see
     # _verify_step).  Decode is bandwidth-bound, so the wider step costs
-    # ~one weight pass but can emit up to spec_k+1 tokens.  On a pp mesh
-    # the verify step runs GSPMD stage-sequential (pp_decode_step pipelines
-    # only T=1 steps); tp meshes shard it like any decode.
+    # ~one weight pass but can emit up to spec_k+1 tokens.
+    #
+    # On the fused engine (the default: step_token_budget > 0, no pp
+    # mesh) the WHOLE loop is on-device and composes with the decode
+    # horizon: a jitted prompt-lookup proposer scans each row's
+    # device-resident token history (ops/speculate.py), the [R, spec_k+1]
+    # verify forward and the acceptance walk ride INSIDE
+    # ``_decode_horizon_loop``'s while_loop, and a horizon step emits
+    # 1..spec_k+1 tokens per iteration with no extra dispatch (JP106
+    # still gates the tick at ==1) and no per-step sync.  The sequential
+    # engine (step_token_budget=0) keeps the host-walk ``_spec_step`` —
+    # the seeded bit-identity oracle — and a pp mesh keeps the
+    # stage-sequential ``_pp_verify_step`` (GPipe pipelines only T=1
+    # steps at H=1; the fused tick is a single-program engine path).
     spec_k: int = 0
-    spec_ngram: int = 3         # n-gram length for host-side lookup
+    spec_ngram: int = 3         # n-gram length for the lookup proposer
     # fused decode horizon: >1 runs up to H decode+sample steps in ONE
     # jitted on-device loop (``_decode_multi_step``: ``lax.while_loop``
     # that exits early once every row is dead) with device-resident engine
@@ -106,8 +117,8 @@ class EngineConfig:
     # orchestration, not FLOPs; the vLLM multi-step / MaxText on-device
     # generate-loop peers).  Per-row EOS/length early-stop is masked on
     # device, so fused output is bit-identical to H=1 under the seeded-
-    # stream contract.  Streaming granularity becomes up to H tokens.
-    # Mutually exclusive with spec_k for now (both widen the step).
+    # stream contract.  Streaming granularity becomes up to H tokens
+    # (times spec_k+1 when speculative decode rides the same loop).
     decode_horizon: int = 1
     # mixed prefill+decode step: per-tick prefill token budget for the
     # admission wave.  While ANY row is prefilling, the engine runs
@@ -271,7 +282,8 @@ def _chain_hashes(prompt: np.ndarray, page_size: int) -> list[bytes]:
 
 def _decode_horizon_loop(cfg: ModelConfig, params, cache, toks, row_lens,
                          active, temps, top_ps, key, seeds, steps, top_ks,
-                         eos, remain, horizon: int):
+                         eos, remain, horizon: int, hist=None, spec_ks=None,
+                         spec_k: int = 0, spec_ngram: int = 3):
     """The fused decode horizon BODY: up to ``horizon`` decode+sample
     steps over the whole row pool (a ``lax.while_loop`` — not
     ``lax.scan``, because the loop must exit early the moment every row
@@ -291,8 +303,36 @@ def _decode_horizon_loop(cfg: ModelConfig, params, cache, toks, row_lens,
     position computes exactly what the H=1 step computes (same forward,
     same split-per-step key chain, same fold_in(seed, output_index)
     stream), so fused output is bit-identical to H=1.
+
+    ``spec_k > 0`` (static) selects the SPECULATIVE loop body: each
+    iteration proposes up to ``spec_k`` prompt-lookup drafts per row from
+    the device-resident token history ``hist`` [R, S] (``hist[r,
+    row_lens[r]]`` is the row's current token — ops/speculate.py, the
+    jitted twin of the host ``_propose_ngram``), runs ONE [R, spec_k+1]
+    verify forward, samples every position with the row's params keyed by
+    OUTPUT INDEX (``_sample_verify_positions`` — the same definition the
+    host-walk ``_verify_step`` traces), and walks the acceptance chain ON
+    DEVICE: emit s_0; while the draft fed at position j equals the token
+    just emitted, s_j is a draw from the true conditional — so every
+    emitted token has the plain engine's distribution (seeded rows
+    bit-identically, greedy rows token-identically), and an iteration
+    emits 1..spec_k+1 tokens.  EOS/budget truncation happens inside the
+    accepted window; rejected drafts' KV slots are dead until overwritten
+    (the paged pool's free rollback — the write cursor just doesn't
+    advance past them).  ``spec_ks`` [R] caps the proposed run per row
+    (0 = a plain step for that row: per-request opt-outs and the pool-
+    contention fallback ride as traced masks, not separate programs).
+    Returns the plain tuple extended with (take_block [R, H], hist,
+    proposed, accepted); tok/lp blocks become [R, H, spec_k+1], positions
+    past a row's per-iteration take masked to padding (0).
     """
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+
+    if spec_k > 0:
+        return _spec_horizon_loop(
+            cfg, params, cache, toks, row_lens, active, temps, top_ps,
+            key, seeds, steps, top_ks, eos, remain, horizon, hist,
+            spec_ks, spec_k, spec_ngram)
 
     def step(n, cache, toks, row_lens, alive, key, steps, remain):
         # dead/masked rows route their (masked) K/V write to the scratch
@@ -358,6 +398,122 @@ def _decode_horizon_loop(cfg: ModelConfig, params, cache, toks, row_lens,
         tok_block, lp_block = tb.T, lb.T               # [H, R] -> [R, H]
     return (tok_block, lp_block, n, cache, toks, row_lens, active, steps,
             remain, key)
+
+
+def _spec_horizon_loop(cfg: ModelConfig, params, cache, toks, row_lens,
+                       active, temps, top_ps, key, seeds, steps, top_ks,
+                       eos, remain, horizon: int, hist, spec_ks,
+                       spec_k: int, spec_ngram: int):
+    """The speculative form of ``_decode_horizon_loop`` (see its
+    docstring for the contract) — split out only to keep the plain body
+    byte-identical to the pre-spec program."""
+    from ipex_llm_tpu.ops.speculate import propose_ngram_rows
+
+    r = toks.shape[0]
+    k1 = spec_k + 1
+    scratch = h2d(cache.tables.shape[1] * cache.page_size, jnp.int32)
+    s_hist = hist.shape[1]
+
+    def spec_step(n, cache, toks, row_lens, alive, key, steps, remain,
+                  hist, prop, acc):
+        # draft: most-recent-n-gram continuation from the device history
+        # (hist[:, :row_lens+1] is prompt + emitted tokens, current token
+        # last); dead/opted-out/contention rows propose nothing and take
+        # a plain step through the same wide program
+        drafts, n_prop = propose_ngram_rows(hist, row_lens + 1, spec_k,
+                                            spec_ngram)
+        n_prop = jnp.where(alive, jnp.minimum(n_prop, spec_ks), 0)
+        drafts = jnp.where(jnp.arange(spec_k)[None, :] < n_prop[:, None],
+                           drafts, 0)
+        # verify: ONE [R, k+1] ragged forward over [cur_tok; drafts].
+        # Dead rows scratch-route their whole window (stale device lens
+        # must never corrupt live pages — the plain body's rule); live
+        # rows write slots row_lens..row_lens+k, of which only the
+        # accepted prefix survives (unbacked tail slots land on the
+        # scratch page via update_layer's valid mask)
+        write_at = jnp.where(alive, row_lens, scratch)
+        tokens = jnp.concatenate([toks[:, None], drafts], axis=1)
+        pos = write_at[:, None] + jnp.arange(k1)[None, :]
+        logits, cache = decoder_forward(
+            cfg, params, tokens, cache, pos, slot_offsets=write_at,
+        )
+        t_all, lp_all, key = _sample_verify_positions(
+            logits, alive, temps, top_ps, key, seeds, steps, top_ks,
+            spec_k)
+        # acceptance chain (the host walk's exact rule): position j+1's
+        # sample is a draw from the true conditional only while the draft
+        # fed there equals the token just emitted
+        okm = (drafts == t_all[:, :spec_k]) & (
+            jnp.arange(spec_k)[None, :] < n_prop[:, None])
+        n_acc = jnp.argmin(jnp.concatenate(
+            [okm, jnp.zeros((r, 1), bool)], axis=1).astype(jnp.int32),
+            axis=1).astype(jnp.int32)
+        # EOS/budget truncation INSIDE the accepted window — the same
+        # boundary the host's _emit walk stops at
+        eos_hit = (t_all[:, :, None] == eos[:, None, :]).any(-1)
+        ehit = eos_hit & (jnp.arange(k1)[None, :] <= n_acc[:, None])
+        any_eos = ehit.any(axis=1)
+        first_eos = jnp.argmax(ehit, axis=1).astype(jnp.int32)
+        n_stop = jnp.where(any_eos, first_eos + 1, n_acc + 1)
+        n_take = jnp.where(alive, jnp.minimum(n_stop, remain), 0)
+        keep = jnp.arange(k1)[None, :] < n_take[:, None]
+        tok_step = jnp.where(keep, t_all, 0)
+        lp_step = jnp.where(keep, lp_all, 0.0)
+        # append the emitted run to the device history (next iteration's
+        # proposer input); masked positions scatter-drop past the buffer
+        hpos = jnp.where(keep, row_lens[:, None] + 1
+                         + jnp.arange(k1)[None, :], s_hist)
+        hist = hist.at[jnp.arange(r)[:, None], hpos].set(t_all,
+                                                         mode="drop")
+        died_eos = any_eos & (first_eos < n_take)
+        row_lens = row_lens + n_take
+        steps = steps + n_take
+        remain = remain - n_take
+        alive = alive & ~died_eos & (remain > 0)
+        new_tok = jnp.take_along_axis(
+            t_all, jnp.maximum(n_take - 1, 0)[:, None], axis=1)[:, 0]
+        toks = jnp.where(alive, new_tok, toks)
+        prop = prop + n_prop.sum()
+        acc = acc + jnp.maximum(n_take - 1, 0).sum()
+        return (n + 1, cache, toks, row_lens, alive, key, steps, remain,
+                hist, prop, acc, tok_step, lp_step, n_take)
+
+    zero = jnp.asarray(0, jnp.int32)
+    if horizon == 1:
+        (n, cache, toks, row_lens, active, key, steps, remain, hist,
+         prop, acc, tok_step, lp_step, n_take) = spec_step(
+            zero, cache, toks, row_lens, active, key, steps, remain,
+            hist, zero, zero)
+        tok_block = tok_step[:, None, :]
+        lp_block = lp_step[:, None, :]
+        take_block = n_take[:, None]
+    else:
+        def body(carry):
+            (n, cache, toks, row_lens, alive, key, steps, remain, hist,
+             prop, acc, tb, lb, kb) = carry
+            (n1, cache, toks, row_lens, alive, key, steps, remain, hist,
+             prop, acc, ts, ls, nt) = spec_step(
+                n, cache, toks, row_lens, alive, key, steps, remain,
+                hist, prop, acc)
+            tb = jax.lax.dynamic_update_index_in_dim(tb, ts, n, 0)
+            lb = jax.lax.dynamic_update_index_in_dim(lb, ls, n, 0)
+            kb = jax.lax.dynamic_update_index_in_dim(kb, nt, n, 0)
+            return (n1, cache, toks, row_lens, alive, key, steps, remain,
+                    hist, prop, acc, tb, lb, kb)
+
+        init = (zero, cache, toks, row_lens, active, key, steps, remain,
+                hist, zero, zero,
+                jnp.zeros((horizon, r, k1), jnp.int32),
+                jnp.zeros((horizon, r, k1), jnp.float32),
+                jnp.zeros((horizon, r), jnp.int32))
+        (n, cache, toks, row_lens, active, key, steps, remain, hist,
+         prop, acc, tb, lb, kb) = jax.lax.while_loop(
+            lambda c: (c[0] < horizon) & c[4].any(), body, init)
+        tok_block = tb.transpose(1, 0, 2)          # [H, R, k1] -> [R, H, k1]
+        lp_block = lb.transpose(1, 0, 2)
+        take_block = kb.T
+    return (tok_block, lp_block, n, cache, toks, row_lens, active, steps,
+            remain, key, take_block, hist, prop, acc)
 
 
 # donation covers the cache AND every dead-after-call piece of the
@@ -587,14 +743,20 @@ def _mixed_prefill_fn(cfg: ModelConfig, params, cache, tokens, base_lens,
 # host rebinds its _dev handles to the returned arrays — while temps/
 # top_ps/seeds/top_ks/eos are held across epochs and the PRNG key is
 # checkpoint-held BY REFERENCE for bit-identical transient retry (PR 6's
-# rule), so neither may be donated.  The prefill block's arrays are fresh
+# rule), so neither may be donated.  ``hist`` (the speculative token
+# history, spec_k > 0 only) is device-resident dead-after-call state like
+# toks — the host rebinds _dev["hist"] to the returned buffer — so it
+# donates by name.  The prefill block's arrays and ``spec_ks`` are fresh
 # per-tick uploads, too small to matter.  JP101 locks both directions.
-@partial(jax.jit, static_argnames=("cfg", "horizon", "with_decode", "mesh"),
-         donate_argnums=(2, 3, 4, 5, 10, 13))
+@partial(jax.jit,
+         static_argnames=("cfg", "horizon", "with_decode", "spec_k",
+                          "spec_ngram", "mesh"),
+         donate_argnums=(2, 3, 4, 5, 10, 13), donate_argnames=("hist",))
 def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
                     active, temps, top_ps, key, seeds, steps, top_ks,
                     eos, remain, prefill=None, horizon: int = 1,
-                    with_decode: bool = True, mesh=None):
+                    with_decode: bool = True, hist=None, spec_ks=None,
+                    spec_k: int = 0, spec_ngram: int = 3, mesh=None):
     """ONE device program per engine tick, whatever the admission mix —
     the ragged-paged-attention superkernel tick (ROADMAP item 1; the
     JP106 gate counts exactly this entry).
@@ -631,6 +793,15 @@ def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
     behaviour.  Returns (first_t [P], first_lp [P] — None without a
     prefill block —, [R, H] tokens, [R, H] logprobs, steps executed,
     cache, toks, row_lens, active, steps, remain, key).
+
+    ``spec_k > 0`` (static) runs stage 3 as the SPECULATIVE horizon loop
+    (``_spec_horizon_loop``: on-device draft from ``hist``, [R, spec_k+1]
+    verify, on-device acceptance — still ONE dispatch, JP106 unchanged);
+    stage 2 additionally publishes a completing row's first token into
+    ``hist`` so a prompt that finishes this tick can speculate on its
+    very first decode iteration.  The return tuple then extends to
+    (..., key, take_block [R, H], hist, draft_proposed, draft_accepted)
+    with [R, H, spec_k+1] token/logprob blocks.
     """
     from ipex_llm_tpu.ops import dispatch
     from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
@@ -674,7 +845,22 @@ def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
                 mode="drop")
             active = active.at[p_rowmap].set(
                 jnp.where(p_emit, join, active[p_rowmap]), mode="drop")
-        if with_decode:
+            if spec_k > 0:
+                # a completing row's history gains its first token ON
+                # DEVICE (the prompt itself landed with the admission
+                # epoch upload), so the decode stage below can already
+                # draft for it; pad slots and non-emitting rows drop
+                hpos = jnp.where(p_emit, new_len, hist.shape[1])
+                hist = hist.at[p_rowmap, hpos].set(first_t, mode="drop")
+        if with_decode and spec_k > 0:
+            (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
+             steps, remain, key, take_block, hist, prop,
+             acc) = _decode_horizon_loop(
+                cfg, params, cache, toks, row_lens, active, temps,
+                top_ps, key, seeds, steps, top_ks, eos, remain, horizon,
+                hist=hist, spec_ks=spec_ks, spec_k=spec_k,
+                spec_ngram=spec_ngram)
+        elif with_decode:
             (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
              steps, remain, key) = _decode_horizon_loop(
                 cfg, params, cache, toks, row_lens, active, temps,
@@ -683,6 +869,10 @@ def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
             tok_block = jnp.zeros((r, horizon), jnp.int32)
             lp_block = jnp.zeros((r, horizon), jnp.float32)
             n_exec = jnp.asarray(0, jnp.int32)
+    if spec_k > 0:
+        return (first_t, first_lp, tok_block, lp_block, n_exec, cache,
+                toks, row_lens, active, steps, remain, key, take_block,
+                hist, prop, acc)
     return (first_t, first_lp, tok_block, lp_block, n_exec, cache, toks,
             row_lens, active, steps, remain, key)
 
@@ -718,12 +908,8 @@ class ServingEngine:
                 "in HBM")
         self.cfg = cfg
         self.ec = engine_config or EngineConfig()
-        if self.ec.spec_k > 0 and self.ec.decode_horizon > 1:
-            # both widen the step; composing them (speculate inside the
-            # horizon scan) is future work — refuse rather than silently
-            # pick one
-            raise ValueError(
-                "spec_k and decode_horizon are mutually exclusive for now")
+        if self.ec.spec_k > 0 and self.ec.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1 when spec_k > 0")
         if self.ec.decode_horizon < 1:
             raise ValueError("decode_horizon must be >= 1")
         if (self.ec.step_token_budget is not None
@@ -791,13 +977,29 @@ class ServingEngine:
         # mixed prefill+decode step (admission-wave regime): resolved token
         # budget per tick; 0 = sequential one-row-one-chunk admission.  The
         # pp engine keeps the sequential path (the mixed forward would run
-        # GSPMD stage-sequential instead of the GPipe schedule), and spec_k
-        # engines admit sequentially between verify steps.
+        # GSPMD stage-sequential instead of the GPipe schedule).
         self._step_budget = (self.ec.prefill_bucket
                              if self.ec.step_token_budget is None
                              else int(self.ec.step_token_budget))
-        self._mixed_mode = (self._step_budget > 0 and self.ec.spec_k == 0
-                            and not self._pp_mode)
+        self._mixed_mode = self._step_budget > 0 and not self._pp_mode
+        # on-device speculative decode inside the fused tick: the mixed/
+        # horizon engine threads spec through _ragged_tick_fn (draft +
+        # verify + accept in the device horizon loop, still one dispatch).
+        # The sequential engine (step_token_budget=0) and the pp engine
+        # keep the host-walk _spec_step — the former is the seeded
+        # bit-identity oracle the fused path is tested against, the
+        # latter genuinely cannot fuse (GPipe pipelines T=1 steps only).
+        self._fused_spec = self.ec.spec_k > 0 and self._mixed_mode
+        if (self.ec.spec_k > 0 and self.ec.decode_horizon > 1
+                and not self._fused_spec):
+            # the host-walk paths run ONE verify round per tick and would
+            # silently drop the requested horizon — refuse loudly (the
+            # genuinely unsupported combos: a pp mesh, or the sequential
+            # budget=0 oracle engine)
+            raise ValueError(
+                "decode_horizon > 1 with spec_k > 0 needs the fused "
+                "engine (step_token_budget > 0 and no pp mesh); the "
+                "host-walk verify path cannot fuse horizons")
         self.alloc = PageAllocator(self.ec.n_pages)
         self.tables = np.full((r, self.ec.max_pages), -1, np.int32)
         # block-table dirty-row tracking: every host-side mutation of
@@ -854,6 +1056,10 @@ class ServingEngine:
         # rolling TTFT window for /health (what the admission-wave mixed
         # step is judged on)
         self._ttfts: "deque[float]" = deque(maxlen=128)
+        # rolling speculative-acceptance window for /health: per-tick
+        # (drafts proposed, drafts accepted) pairs — checkpoint/rollback-
+        # safe like the TTFT window, so a retried tick never double-counts
+        self._spec_window: "deque[tuple[int, int]]" = deque(maxlen=128)
         self.metrics = {"requests": 0, "tokens": 0, "steps": 0,
                         "prefix_hits": 0, "prefix_pages_shared": 0,
                         # host-sync economics (the fused-horizon story):
@@ -938,6 +1144,27 @@ class ServingEngine:
             "prefix_evictions": a.prefix_evictions,
             "alloc_fail_clamps": self.metrics.get("alloc_fail_clamps", 0),
             "horizon_clamped": self.metrics.get("horizon_clamped", 0),
+        }
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding observability for /health and the bench
+        sweeps: lifetime draft economics, the rolling accept rate (128-
+        tick window — what the operator tunes spec_k/spec_ngram against),
+        and tokens emitted per spec-tick dispatch (the amortization the
+        on-device loop buys; 0 when spec is off or nothing ran)."""
+        m = self.metrics
+        win = list(self._spec_window)
+        w_prop = sum(p for p, _ in win)
+        w_acc = sum(a for _, a in win)
+        return {
+            "spec_k": self.ec.spec_k,
+            "spec_ngram": self.ec.spec_ngram,
+            "fused": self._fused_spec,
+            "draft_proposed": m.get("draft_proposed", 0),
+            "draft_accepted": m.get("draft_accepted", 0),
+            "accept_rate": round(w_acc / w_prop, 4) if w_prop else 0.0,
+            "accept_rate_lifetime": m.get("spec_accept_rate", 0.0),
+            "tokens_per_dispatch": m.get("spec_tokens_per_dispatch", 0.0),
         }
 
     @property
@@ -1033,6 +1260,7 @@ class ServingEngine:
             "key": self.key,
             "metrics": dict(self.metrics),
             "ttfts": list(self._ttfts),
+            "spec_window": list(self._spec_window),
             "reqs": [(r, len(r.output_ids), len(r.logprobs),
                       r.finish_reason, r.first_token_s) for r in reqs],
         }
@@ -1065,6 +1293,8 @@ class ServingEngine:
         # the doomed tick (or a bisection probe) was never emitted, and the
         # retried tick will record it again
         self._ttfts = deque(snap["ttfts"], maxlen=self._ttfts.maxlen)
+        self._spec_window = deque(snap["spec_window"],
+                                  maxlen=self._spec_window.maxlen)
         # metrics revert wholesale except the cross-thread counter submit()
         # bumps (a rejection during the doomed tick really happened)
         m = dict(snap["metrics"])
@@ -1351,6 +1581,20 @@ class ServingEngine:
             "remain": h2d(remain),
             "eos": h2d(eos),
         }
+        if self._fused_spec:
+            # device-resident token history for the on-device prompt-
+            # lookup proposer: the FULL prompt lands at the admission
+            # epoch (it is known in whole then, so mid-prefill rows need
+            # no per-chunk scatter), emitted tokens are appended inside
+            # the device loop, and epochs rebuild it from the host's own
+            # bookkeeping — the same discipline as toks/row_lens
+            hist = np.zeros((len(rows), self.ec.max_seq_len), np.int32)
+            for i, r in enumerate(rows):
+                if r is None:
+                    continue
+                ids = list(r.prompt_ids) + list(r.output_ids)
+                hist[i, :len(ids)] = ids
+            self._dev["hist"] = h2d(hist)
         # tables ride the dirty-row scatter even on full epochs: every
         # mixed tick is an epoch (row_lens advance), and re-uploading the
         # whole [R, maxP] table per chunk is the cost this PR removes
@@ -1634,6 +1878,72 @@ class ServingEngine:
         self.metrics["last_error"] = f"{type(exc).__name__}: {exc}"
         self.metrics["queue_depth"] = self.queue_depth
 
+    def _row_spec_k(self, req: Request) -> int:
+        """ONE definition of a request's draft width: the engine spec_k,
+        capped by Request.spec_k, zero when opted out (speculative=False)
+        — every reservation/mask site must agree on it exactly."""
+        if req.speculative is False:
+            return 0
+        return (self.ec.spec_k if req.spec_k is None
+                else max(0, min(int(req.spec_k), self.ec.spec_k)))
+
+    def _spec_widths(self, active: np.ndarray) -> np.ndarray:
+        """Per-row draft width for a fused-spec tick — the per-request
+        knobs as TRACED MASKS, so one compiled program serves every
+        opt-out mix."""
+        ks = np.zeros((len(self.rows),), np.int32)
+        for i, req in enumerate(self.rows):
+            if req is None or not active[i]:
+                continue
+            ks[i] = self._row_spec_k(req)
+        return ks
+
+    def _spec_metrics(self, take_block: np.ndarray, s_prop, s_acc,
+                      executed: int):
+        """Fused-spec tick accounting: the verify-round counters the
+        sequential host walk kept (spec_steps/spec_emitted/accept_rate —
+        one loop iteration is one verify round) plus the draft-economics
+        pair the /health spec block and the bench sweep report.  All of
+        it lives in the checkpointed metrics dict / rolling window, so a
+        rolled-back tick never double-counts."""
+        k = self.ec.spec_k
+        emitted = int(take_block.sum())
+        row_steps = int((take_block > 0).sum())
+        prop = int(d2h(s_prop))  # jaxlint: disable=JL002 -- rides THE per-horizon sync (same dispatched program): draft-economics scalars
+        acc = int(d2h(s_acc))  # jaxlint: disable=JL002 -- rides the same per-horizon sync as s_prop above
+        m = self.metrics
+        m["spec_steps"] = m.get("spec_steps", 0) + executed
+        m["spec_ticks"] = m.get("spec_ticks", 0) + 1
+        m["spec_emitted"] = m.get("spec_emitted", 0) + emitted
+        m["spec_row_steps"] = m.get("spec_row_steps", 0) + row_steps
+        m["spec_accept_rate"] = round(
+            m["spec_emitted"] / ((k + 1) * max(m["spec_row_steps"], 1)), 4)
+        m["draft_proposed"] = m.get("draft_proposed", 0) + prop
+        m["draft_accepted"] = m.get("draft_accepted", 0) + acc
+        m["spec_tokens_per_dispatch"] = round(
+            m["spec_emitted"] / max(m["spec_ticks"], 1), 2)
+        self._spec_window.append((prop, acc))
+
+    def _drain_spec_block(self, tok_block, lp_block, take_block,
+                          active: np.ndarray, h: int):
+        """Walk an [R, h, k+1] spec token/logprob block through the exact
+        per-token emission path: iteration j of row i emitted
+        ``take_block[i, j]`` tokens (device-truncated at the same
+        EOS/budget boundary the host's _emit walks)."""
+        for i in range(len(self.rows)):
+            if not active[i] or self.rows[i] is None:
+                continue
+            for j in range(h):
+                for t in range(int(take_block[i, j])):
+                    self.row_lens[i] += 1
+                    tok = int(tok_block[i, j, t])
+                    self.toks[i] = tok
+                    self._emit(i, tok, float(lp_block[i, j, t]))
+                    if self.rows[i] is None:   # finished mid-run
+                        break
+                if self.rows[i] is None:
+                    break
+
     def _spec_step(self, active: np.ndarray):
         """One speculative (prompt-lookup verify) step over the active rows."""
         k = self.ec.spec_k
@@ -1741,6 +2051,18 @@ class ServingEngine:
         self.metrics["spec_accept_rate"] = round(
             self.metrics["spec_emitted"]
             / ((k + 1) * max(self.metrics["spec_row_steps"], 1)), 4)
+        # draft economics: the host walk feeds the SAME counters and
+        # rolling window the fused tick feeds (_spec_metrics), so
+        # /health's spec block is meaningful on the oracle/pp engines too
+        prop = int(n_prop.sum())
+        acc = emitted_total - int(active.sum())   # each row's free token
+        m = self.metrics
+        m["spec_ticks"] = m.get("spec_ticks", 0) + 1
+        m["draft_proposed"] = m.get("draft_proposed", 0) + prop
+        m["draft_accepted"] = m.get("draft_accepted", 0) + acc
+        m["spec_tokens_per_dispatch"] = round(
+            m["spec_emitted"] / max(m["spec_ticks"], 1), 2)
+        self._spec_window.append((prop, acc))
         self.metrics["tokens_per_sync"] = round(
             self.metrics["tokens"] / self.metrics["host_syncs"], 2)
 
@@ -1762,8 +2084,10 @@ class ServingEngine:
         chunk, on-device first-token merge, and the decode step into the
         single ``_ragged_tick_fn`` program; steady state → the fused
         decode horizon through the SAME entry (bit-identical to the
-        historical ``_decode_multi_step``); spec_k / pp engines keep the
-        sequential one-row-one-chunk admission path."""
+        historical ``_decode_multi_step``).  ``spec_k`` rides INSIDE that
+        one program on the fused engine (on-device draft+verify+accept);
+        only the sequential (budget=0) oracle and pp engines keep the
+        one-row-one-chunk admission path with the host-walk verify."""
         self._drain_inbox()
         self._expire_deadlines()
         self.metrics["queue_depth"] = self.queue_depth
@@ -1781,7 +2105,9 @@ class ServingEngine:
                 return  # keep chunking
             self._wait_for_work()
             return
-        if self.ec.spec_k > 0:
+        if self.ec.spec_k > 0 and not self._fused_spec:
+            # the host-walk verify step: the sequential (budget=0) oracle
+            # and the pp engine's stage-sequential wide step
             self._spec_step(active)
             return
         self._horizon_step(active)
@@ -1876,24 +2202,54 @@ class ServingEngine:
         # decided pre-dispatch).  This runs AFTER every row's chunk
         # pages are ensured: under pool pressure the extra decode page
         # must never starve a later row's prefill chunk (which would
-        # turn that request's graceful progress into a hard 'error')
+        # turn that request's graceful progress into a hard 'error').
+        # Fused-spec joiners additionally reserve their draft window
+        # (min(k+1, budget after the first token) slots) so a prompt
+        # completing this tick can speculate on its first decode
+        # iteration; a pool that can back only the plain slot zeroes the
+        # row's traced spec width instead (no_spec as a mask).
+        spec_ks = (np.zeros((len(self.rows),), np.int32)
+                   if self._fused_spec else None)
         for i, row, n_i in chunks:
-            if emit[i]:
+            if not emit[i]:
+                continue
+            req = self.rows[row]
+            k_i = self._row_spec_k(req) if spec_ks is not None else 0
+            want = max(min(k_i + 1, req.max_new_tokens - 1), 1)
+            canjoin[i] = self._ensure_pages(
+                row, int(base[i]) + n_i + want, req=req)
+            if not canjoin[i] and k_i:
+                k_i = 0
                 canjoin[i] = self._ensure_pages(
-                    row, int(base[i]) + n_i + 1, req=self.rows[row])
+                    row, int(base[i]) + n_i + 1, req=req)
+            if spec_ks is not None:
+                spec_ks[row] = k_i
         # decode participants need their next KV slot backed BEFORE the
         # single dispatch (the old second dispatch's pre-allocation): a
         # row the pool cannot back finishes 'length' here and is
         # excluded from the uploaded active mask.  (No horizon clamp
         # like _horizon_step's: at want=1 a failed ensure always means
-        # zero backed slots remain.)
+        # zero backed slots remain.)  Fused-spec rows reserve their
+        # draft window first and drop to the plain width under pressure.
         active = self._active_mask()
         for i in range(len(self.rows)):
             if not active[i]:
                 continue
-            if not self._ensure_pages(i, int(self.row_lens[i]) + 1):
-                self._finish(i, "length")
-                active[i] = False
+            k_i = (self._row_spec_k(self.rows[i])
+                   if spec_ks is not None else 0)
+            rem_i = (int(self.row_budget[i])
+                     - len(self.rows[i].output_ids))
+            want = max(min(k_i + 1, rem_i), 1)
+            if not self._ensure_pages(i, int(self.row_lens[i]) + want):
+                if k_i and self._ensure_pages(i,
+                                              int(self.row_lens[i]) + 1):
+                    k_i = 0
+                else:
+                    self._finish(i, "length")
+                    active[i] = False
+                    continue
+            if spec_ks is not None:
+                spec_ks[i] = k_i
         # pure-chunk ticks with nothing decoding skip the decode stage
         # entirely (statically): no all-masked forward, and the key chain
         # advances only by the prefill split — the chained path's exact
@@ -1943,14 +2299,35 @@ class ServingEngine:
         dev = self._sync_device_state()
         prefill = (h2d(toks), p_tables, h2d(base), h2d(n_valid),
                    h2d(emit), h2d(canjoin), h2d(rowmap))
-        (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
-         dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
-         dev["remain"], self.key) = _ragged_tick_fn(
-            self.cfg, self.params, self.cache, dev["toks"],
-            dev["row_lens"], dev["active"], dev["temps"], dev["top_ps"],
-            self.key, dev["seeds"], dev["steps"], dev["top_ks"],
-            dev["eos"], dev["remain"], prefill=prefill, horizon=1,
-            with_decode=with_decode, mesh=self.mesh)
+        # a pure-chunk tick (with_decode=False) has no decode stage for
+        # spec to ride, so it dispatches the spec-free program variant —
+        # the device history needs no maintenance there (prompts land
+        # whole at epoch uploads, and nothing is emitted)
+        tick_spec = self._fused_spec and with_decode
+        take_block = s_prop = s_acc = None
+        if tick_spec:
+            (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
+             dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
+             dev["remain"], self.key, take_block, dev["hist"], s_prop,
+             s_acc) = _ragged_tick_fn(
+                self.cfg, self.params, self.cache, dev["toks"],
+                dev["row_lens"], dev["active"], dev["temps"],
+                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                dev["top_ks"], dev["eos"], dev["remain"],
+                prefill=prefill, horizon=1, with_decode=True,
+                hist=dev["hist"], spec_ks=h2d(spec_ks),
+                spec_k=self.ec.spec_k, spec_ngram=self.ec.spec_ngram,
+                mesh=self.mesh)
+        else:
+            (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
+             dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
+             dev["remain"], self.key) = _ragged_tick_fn(
+                self.cfg, self.params, self.cache, dev["toks"],
+                dev["row_lens"], dev["active"], dev["temps"],
+                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                dev["top_ks"], dev["eos"], dev["remain"],
+                prefill=prefill, horizon=1,
+                with_decode=with_decode, mesh=self.mesh)
         # advance bookkeeping; completed prompts run the shared
         # completion path (_finish_prompt) once their token arrives
         completing: list[tuple[int, int]] = []   # (slot, row)
@@ -1998,7 +2375,13 @@ class ServingEngine:
         # the drain walk covers the decode participants: rows already
         # decoding plus completions that joined on device; rows finished
         # above (first-token EOS/budget/length) are None and skip
-        self._drain_block(tok_np, lp_np, self._active_mask(), executed)
+        if tick_spec:
+            take_np = d2h(take_block)  # jaxlint: disable=JL002 -- rides THE per-tick sync: per-iteration accepted counts for the drain walk
+            self._spec_metrics(take_np, s_prop, s_acc, executed)
+            self._drain_spec_block(tok_np, lp_np, take_np,
+                                   self._active_mask(), executed)
+        else:
+            self._drain_block(tok_np, lp_np, self._active_mask(), executed)
         self.metrics["tokens_per_sync"] = round(
             self.metrics["tokens"] / max(self.metrics["host_syncs"], 1), 2)
 
@@ -2021,8 +2404,15 @@ class ServingEngine:
             H = 1
         # pre-allocate pages for the whole horizon; a tight pool shortens
         # the horizon for the step (power-of-two buckets bound recompiles)
-        # instead of truncating requests the plain engine could still serve
+        # instead of truncating requests the plain engine could still serve.
+        # A fused-spec row wants min(H * (k_row+1), remaining budget)
+        # slots — accepted tokens never outrun the budget, and writes past
+        # the backed range are rejected drafts the scratch page absorbs —
+        # and falls back to the plain width (spec off for this tick, the
+        # traced-mask form of _spec_step's no_spec fallback) before the
+        # whole tick's horizon is clamped on its account.
         h = H
+        spec_ks = self._spec_widths(active) if self._fused_spec else None
         for i in range(len(self.rows)):
             if not active[i]:
                 continue
@@ -2030,10 +2420,15 @@ class ServingEngine:
             # a near-finished row only reserves what its budget can write —
             # never H-1 dead slots that could starve another row's ensure
             # (its post-death masked rewrites route to the scratch page)
-            want = min(H, int(self.row_budget[i])
-                       - len(self.rows[i].output_ids))
+            rem_i = int(self.row_budget[i]) - len(self.rows[i].output_ids)
+            k_i = int(spec_ks[i]) if spec_ks is not None else 0
+            want = min(H * (k_i + 1), rem_i)
             if self._ensure_pages(i, lens + max(want, 1)):
                 continue
+            if k_i:
+                spec_ks[i] = 0      # pool pressure: plain step this tick
+                if self._ensure_pages(i, lens + max(min(H, rem_i), 1)):
+                    continue
             backed = (int((self.tables[i] >= 0).sum()) * self.ec.page_size
                       - lens)
             if backed < 1:
@@ -2063,6 +2458,21 @@ class ServingEngine:
             # entry but re-uploads per step until it learns the epoch sync
             self._dirty = True
             executed = 1
+        elif self._fused_spec:
+            # the spec-enabled form of the SAME single entry: drafting,
+            # the [R, k+1] verify, and acceptance all ride inside the
+            # horizon loop — still one dispatch (JP106 unchanged)
+            (_, _, tok_block, lp_block, n_exec, self.cache, dev["toks"],
+             dev["row_lens"], dev["active"], dev["steps"], dev["remain"],
+             self.key, take_block, dev["hist"], s_prop,
+             s_acc) = _ragged_tick_fn(
+                self.cfg, self.params, self.cache, dev["toks"],
+                dev["row_lens"], dev["active"], dev["temps"],
+                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                dev["top_ks"], dev["eos"], dev["remain"],
+                prefill=None, horizon=h, hist=dev["hist"],
+                spec_ks=h2d(spec_ks), spec_k=self.ec.spec_k,
+                spec_ngram=self.ec.spec_ngram, mesh=self.mesh)
         else:
             # the steady-state tick is the SAME single jitted entry the
             # mixed tick uses, with no prefill block: one program either
@@ -2089,7 +2499,13 @@ class ServingEngine:
         self.metrics["steps"] += executed
         self.metrics["decode_horizon_effective"] = h
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
-        self._drain_block(tok_block, lp_block, active, executed)
+        if self._fused_spec and not self._pp_mode:
+            take_block = d2h(take_block)  # jaxlint: disable=JL002 -- rides THE per-horizon sync: per-iteration accepted counts for the drain walk
+            self._spec_metrics(take_block, s_prop, s_acc, executed)
+            self._drain_spec_block(tok_block, lp_block, take_block,
+                                   active, executed)
+        else:
+            self._drain_block(tok_block, lp_block, active, executed)
         self.metrics["tokens_per_sync"] = round(
             self.metrics["tokens"] / self.metrics["host_syncs"], 2)
 

@@ -291,6 +291,18 @@ def main(argv=None):
                     help="KV pool byte budget (page count derived from "
                          "bytes / page size at --kv-storage width; 0 = "
                          "auto page sizing)")
+    ap.add_argument("--spec-k", type=int, default=0, metavar="K",
+                    help="on-device prompt-lookup speculative serving "
+                         "(reference ipex_llm_worker `speculative` load "
+                         "flag): draft/verify/accept up to K candidates "
+                         "per row per decode step inside the fused tick; "
+                         "composes with --decode-horizon")
+    ap.add_argument("--spec-ngram", type=int, default=3, metavar="N",
+                    help="longest n-gram the speculative lookup proposer "
+                         "matches against the row's token history")
+    ap.add_argument("--decode-horizon", type=int, default=1, metavar="H",
+                    help="fused multi-step decode: H decode steps per "
+                         "device program, one host sync per H tokens")
     ap.add_argument("--no-register", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=30.0,
                     metavar="SECONDS",
@@ -306,7 +318,9 @@ def main(argv=None):
                      engine_config=EngineConfig(
                          max_rows=args.limit_worker_concurrency,
                          kv_storage=args.kv_storage,
-                         kv_pool_bytes=args.kv_pool_bytes))
+                         kv_pool_bytes=args.kv_pool_bytes,
+                         spec_k=args.spec_k, spec_ngram=args.spec_ngram,
+                         decode_horizon=args.decode_horizon))
     if w.controller_addr:
         async def on_start(app):
             app["hb"] = asyncio.create_task(w.heartbeat_loop())

@@ -479,6 +479,25 @@ def test_manifest_locks_engine_donation_map():
                                    "eos", "key"}, key
 
 
+def test_manifest_locks_spec_tick_donation_map():
+    """The speculative tick variants (spec=4 grid points) donate the full
+    device-state set INCLUDING the token-history ring ``hist`` (the host
+    rebinds _dev["hist"] per tick), while the held set — sampling params,
+    eos, and the checkpoint-held PRNG key — still never aliases."""
+    lock = json.loads(manifest_mod.DEFAULT_PATH.read_text())
+    entries = lock["programs"]["serving.ragged_tick"]["entries"]
+    spec_entries = {k: v for k, v in entries.items() if "spec=4" in k}
+    assert spec_entries, "spec grid points missing from the manifest"
+    kvs = {k.split("kv=")[1].split(",")[0] for k in spec_entries}
+    assert kvs == {"bf16", "fp8"}
+    for key, entry in spec_entries.items():
+        aliased = {a.split("[")[0] for a in entry["aliases"]}
+        assert {"cache", "toks", "row_lens", "active", "steps", "remain",
+                "hist"} <= aliased, key
+        assert not aliased & {"temps", "top_ps", "seeds", "top_ks",
+                              "eos", "key"}, key
+
+
 def test_alias_parse_tolerates_quoted_sharding_braces():
     """mhlo.sharding attrs carry quoted nested braces; a flat brace regex
     truncated the attr dict and silently dropped real aliases (which

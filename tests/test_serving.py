@@ -581,13 +581,16 @@ def test_speculative_per_request_spec_k(cfg_params, monkeypatch):
     make acceptance DETERMINISTIC (prompt-lookup hit rates depend on the
     random model), the second phase feeds the proposer the first run's own
     greedy stream — every draft then matches, so an unlimited request must
-    finish in ~1/(k+1) of the steps."""
+    finish in ~1/(k+1) of the steps.  Pinned to the sequential engine
+    (step_token_budget=0): that is the path whose HOST proposer the
+    monkeypatch below can substitute — the fused engine drafts on device
+    (tests/test_serving_spec.py covers its per-request caps)."""
     cfg, params = cfg_params
     prompt = [3, 5, 7, 9, 11, 13]
     eng = ServingEngine(
         cfg, params,
         EngineConfig(max_rows=2, max_seq_len=256, prefill_bucket=32,
-                     spec_k=3),
+                     spec_k=3, step_token_budget=0),
     ).start()
     try:
         r0 = eng.submit(Request(prompt_ids=prompt, max_new_tokens=12,

@@ -166,8 +166,22 @@ def test_no_param_reupload_between_epochs(cfg_params, monkeypatch):
     assert eng.metrics["tokens_per_sync"] > 0
 
 
-def test_spec_k_and_horizon_mutually_exclusive(cfg_params):
+def test_spec_k_composes_with_horizon(cfg_params):
+    """The PR 1 mutual-exclusion guard is gone: spec_k rides INSIDE the
+    fused horizon loop now (on-device draft/verify/accept,
+    tests/test_serving_spec.py carries the equivalence suite).  The
+    fused engine constructs and routes spec through the tick; the
+    sequential (budget=0) oracle keeps the host-walk path at H=1, and a
+    horizon it cannot fuse is refused loudly instead of silently
+    dropped (the one genuinely unsupported combo besides a pp mesh)."""
     cfg, params = cfg_params
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(spec_k=2, decode_horizon=4))
+    assert eng._fused_spec
+    seq = ServingEngine(cfg, params,
+                        EngineConfig(spec_k=2, step_token_budget=0))
+    assert not seq._fused_spec
+    with pytest.raises(ValueError, match="fused engine"):
         ServingEngine(cfg, params,
-                      EngineConfig(spec_k=2, decode_horizon=4))
+                      EngineConfig(spec_k=2, decode_horizon=4,
+                                   step_token_budget=0))
