@@ -10,7 +10,12 @@ decode step over a fixed row pool; requests join/leave rows between steps
 
 from ipex_llm_tpu.serving.engine import EngineConfig, Request, ServingEngine
 from ipex_llm_tpu.serving.faults import (DeterministicFault, EngineOverloaded,
-                                         FaultInjector, TransientFault)
+                                         FaultInjector, ReplicaFault,
+                                         TransientFault)
+from ipex_llm_tpu.serving.router import (HTTPBackend, InProcessBackend,
+                                         Router, RouterConfig)
 
 __all__ = ["ServingEngine", "EngineConfig", "Request", "FaultInjector",
-           "EngineOverloaded", "TransientFault", "DeterministicFault"]
+           "EngineOverloaded", "TransientFault", "DeterministicFault",
+           "ReplicaFault", "Router", "RouterConfig", "HTTPBackend",
+           "InProcessBackend"]

@@ -51,6 +51,13 @@ class OpenAIServer:
         self.tok = tokenizer
         self.model_name = model_name
         self.drain_timeout_s = drain_timeout_s
+        # replica identity for the router tier: a stable uuid for this
+        # server's lifetime (a restart mints a new one — that is the
+        # point: the router can tell "same process recovered" from
+        # "process was replaced"), plus the uptime epoch the /health
+        # replica block reports against
+        self.replica_id = uuid.uuid4().hex
+        self.started_monotonic = time.monotonic()
         # asr = (whisper model, feature extractor, tokenizer) enabling the
         # OpenAI audio surface (the reference serves whisper through its
         # workers; SURVEY L6 lists the audio endpoint)
@@ -112,6 +119,11 @@ class OpenAIServer:
                   else None),
             eos_token_id=eos,
             request_id=str(uuid.uuid4()),
+            # per-request wall-clock budget (queue wait + generation); the
+            # router stamps each failover attempt's REMAINING budget here,
+            # so a deadline spans attempts instead of resetting per replica
+            deadline_s=(float(body["deadline_s"])
+                        if body.get("deadline_s") else None),
         )
         stop = body.get("stop")
         req.stop_strings = ([stop] if isinstance(stop, str) else stop) or []
@@ -135,11 +147,24 @@ class OpenAIServer:
     def _openai_reason(fr: str | None) -> str | None:
         return "stop" if fr == "stop_string" else fr
 
+    def _retry_after_s(self, e: EngineOverloaded) -> int:
+        """Honest Retry-After for a shed submission.  Draining: the rest
+        of the drain window (by then the replica has restarted or shed
+        everything).  Queue full: the backlog in units of engine waves —
+        a queue of depth D in front of an R-row engine clears in about
+        D/R admission waves; clamped to [1, 30] so a burst never tells
+        clients to go away for minutes."""
+        if e.draining:
+            return max(1, int(self.engine.drain_seconds_left) + 1)
+        rows = max(1, self.engine.ec.max_rows)
+        return max(1, min(30, -(-e.queue_depth // rows)))
+
     def _submit(self, req: Request) -> Request:
         """Engine submit with load-shedding mapped onto HTTP: a full
         bounded queue is 429 (retryable overload), a draining engine is
         503 (this replica is going away) — both as OpenAI-style error
-        objects with Retry-After."""
+        objects with Retry-After derived from queue depth / the drain
+        deadline (the router's backpressure signal)."""
         try:
             return self.engine.submit(req)
         except EngineOverloaded as e:
@@ -152,7 +177,7 @@ class OpenAIServer:
             cls = (web.HTTPServiceUnavailable if e.draining
                    else web.HTTPTooManyRequests)
             raise cls(text=body, content_type="application/json",
-                      headers={"Retry-After": "1"})
+                      headers={"Retry-After": str(self._retry_after_s(e))})
 
     @staticmethod
     def _error_payload(req: Request) -> dict:
@@ -401,6 +426,15 @@ class OpenAIServer:
             body = {"status": "degraded", "last_error": str(last)}
         if self.engine.draining:
             body["status"] = "draining"
+        # replica identity + liveness for the router tier: a stable uuid
+        # (new per server start — distinguishes "recovered" from
+        # "replaced"), uptime, and the committed-tick counter — `ticks`
+        # frozen while `uptime_s` advances is the wedged-replica signal
+        body["replica"] = {
+            "replica_id": self.replica_id,
+            "uptime_s": round(time.monotonic() - self.started_monotonic, 3),
+            "ticks": self.engine.metrics.get("ticks", 0),
+        }
         # host-sync economics of the fused decode horizon: tokens emitted
         # per blocking device->host sync, total seconds blocked, and the
         # horizon the last fused step actually ran (page pressure can
@@ -442,8 +476,36 @@ class OpenAIServer:
             body["spec"] = self.engine.spec_stats()
         return web.json_response(body)
 
+    def _metrics_numeric(self) -> dict:
+        """Flat numeric counter/gauge map: engine metrics + kv_ pool stats
+        + replica liveness — the per-replica series the router aggregates."""
+        # dict() first: a single GIL-atomic copy — the engine thread
+        # inserts new counter keys at runtime, and iterating the live
+        # dict races a "changed size during iteration" 500
+        out = {k: v for k, v in dict(self.engine.metrics).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        for k, v in self.engine.kv_stats().items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"kv_{k}"] = v
+        out["uptime_s"] = round(
+            time.monotonic() - self.started_monotonic, 3)
+        return out
+
     async def metrics(self, request):
-        return web.json_response(dict(self.engine.metrics))
+        """Prometheus-style text exposition, every series labelled with
+        this replica's stable id so a fleet scrape stays per-replica
+        attributable; ``?format=json`` keeps the machine-readable shape
+        the router's aggregation fetches."""
+        vals = self._metrics_numeric()
+        if request.query.get("format") == "json":
+            return web.json_response(
+                {"replica_id": self.replica_id, "metrics": vals})
+        lines = []
+        for name in sorted(vals):
+            lines.append(f'ipex_llm_tpu_{name}'
+                         f'{{replica_id="{self.replica_id}"}} {vals[name]}')
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     # -- TGI protocol -------------------------------------------------------
 
@@ -459,6 +521,7 @@ class OpenAIServer:
             "top_k": p.get("top_k", 0),
             "stop": p.get("stop"),
             "seed": p.get("seed"),
+            "deadline_s": body.get("deadline_s"),
         }
         ids = list(self.tok(body.get("inputs", ""))["input_ids"])
         return self._mk_request(mapped, ids)

@@ -1045,6 +1045,7 @@ class ServingEngine:
         self._tick_arrivals: list[Request] = []
         self._retries = 0
         self._draining = False
+        self._drain_deadline: float | None = None
         self._drain_abort = threading.Event()
         # device-resident hot state (toks / row_lens / active / sampling
         # params / eos / budgets): uploaded ONLY on epochs — admission,
@@ -1061,6 +1062,12 @@ class ServingEngine:
         # safe like the TTFT window, so a retried tick never double-counts
         self._spec_window: "deque[tuple[int, int]]" = deque(maxlen=128)
         self.metrics = {"requests": 0, "tokens": 0, "steps": 0,
+                        # committed transactional ticks — monotonic even
+                        # when idle (the loop keeps ticking), so a frozen
+                        # value with uptime advancing is the router's
+                        # wedged-replica liveness signal (/health replica
+                        # block)
+                        "ticks": 0,
                         "prefix_hits": 0, "prefix_pages_shared": 0,
                         # host-sync economics (the fused-horizon story):
                         # decode iterations per blocking device->host sync,
@@ -1171,6 +1178,16 @@ class ServingEngine:
     def draining(self) -> bool:
         return self._draining
 
+    @property
+    def drain_seconds_left(self) -> float:
+        """Seconds until the graceful-drain window closes (0.0 when not
+        draining, or when drain was flagged without a recorded deadline)
+        — what a 503 Retry-After is derived from: by then this replica
+        has either finished restarting or shed everything."""
+        if not self._draining or self._drain_deadline is None:
+            return 0.0
+        return max(0.0, self._drain_deadline - time.monotonic())
+
     def abort(self, req: Request):
         """Cancel a request (e.g. client disconnect); its row frees at the
         next step boundary."""
@@ -1183,6 +1200,9 @@ class ServingEngine:
         engine thread keeps running (call ``stop()`` afterwards); /health
         reports "draining" for the duration."""
         self._draining = True
+        # recorded so the HTTP surfaces can derive an honest Retry-After
+        # on the 503 draining path (drain_seconds_left)
+        self._drain_deadline = time.monotonic() + timeout
 
         def busy():
             return (any(r is not None for r in self.rows)
@@ -1349,6 +1369,9 @@ class ServingEngine:
             return False
         self._commit()
         self._retries = 0
+        # post-commit on purpose: a rolled-back tick never advances the
+        # liveness counter, so `ticks` moves iff the engine makes progress
+        self.metrics["ticks"] = self.metrics.get("ticks", 0) + 1
         return True
 
     def _recover(self, exc: BaseException):
@@ -2391,10 +2414,12 @@ class ServingEngine:
         streaming and finish semantics are exactly the H=1 path's."""
         H = 1 if self._pp_mode else self.ec.decode_horizon
         if H > 1 and (self._prefilling or
-                      (not self._inbox.empty()
+                      ((self._pending or not self._inbox.empty())
                        and self._free_row() is not None)):
-            # streams are still joining (prefilling rows, or arrivals that
-            # raced past this step's _admit with a row free to take them):
+            # streams are still joining (prefilling rows, arrivals that
+            # raced past this step's _admit, or a pool-dry requeue waiting
+            # in the engine-owned _pending FIFO — with a row free to take
+            # them once pages come back):
             # fall back to single steps so a joining row never waits out a
             # horizon and the batch fills at the H=1 engine's pace — the
             # fused horizon is for steady-state decode, where it amortizes

@@ -45,6 +45,26 @@ what makes bisection observable.  Sites fire BEFORE the device call they
 guard, so an injected fault never leaves a half-donated cache behind (the
 recovery contract assumes KV writes beyond the committed row_lens are
 scratch, which holds for host-side raises).
+
+One tier up, the REPLICA is the unit of failure (serving/router.py): a
+whole engine process can crash, wedge mid-stream, or go slow-loris on its
+health endpoint.  The ``ReplicaFault`` family models those transport-level
+failures, and the router's backends guard their own sites
+(``REPLICA_FAULT_SITES``) with the same ``FaultInjector`` — each backend
+carries its OWN injector, so chaos is scripted per-replica and a router
+chaos run is deterministic and unit-testable, not only process-kill:
+
+- ``replica-connect`` (``ReplicaConnectRefused``) — fires before a request
+  is sent to the replica: the connect-refused shape a SIGKILLed process
+  produces.  The router must fail over (the request never reached a row).
+- ``replica-stream``  (``ReplicaStreamHang``) — fires before an SSE event
+  read: the backend then stalls past the router's stall timeout, the
+  mid-stream-wedge shape.  Zero delivered tokens → safe replay; delivered
+  tokens → a terminal error event, never a silent truncation.
+- ``replica-health``  (``ReplicaSlowHealth``) — fires on a health probe:
+  the probe hangs past its budget, the slow-loris shape that must count
+  as a failed poll (a wedged replica stops receiving traffic within one
+  probe interval).
 """
 
 from __future__ import annotations
@@ -56,8 +76,13 @@ __all__ = [
     "TransientFault",
     "DeterministicFault",
     "EngineOverloaded",
+    "ReplicaFault",
+    "ReplicaConnectRefused",
+    "ReplicaStreamHang",
+    "ReplicaSlowHealth",
     "FaultInjector",
     "FAULT_SITES",
+    "REPLICA_FAULT_SITES",
     "is_transient",
 ]
 
@@ -82,6 +107,28 @@ class EngineOverloaded(RuntimeError):
         super().__init__(message)
         self.queue_depth = queue_depth
         self.draining = draining
+
+
+class ReplicaFault(RuntimeError):
+    """Base of the replica-tier fault family: transport-level failures of
+    a whole engine replica, injected into the ROUTER's backends (not the
+    engine step) via ``REPLICA_FAULT_SITES``."""
+
+
+class ReplicaConnectRefused(ReplicaFault):
+    """The replica refuses connections (crashed / SIGKILLed process); the
+    router backend translates it into its connect-failure path."""
+
+
+class ReplicaStreamHang(ReplicaFault):
+    """The replica stops producing SSE events mid-stream (wedged engine
+    thread, dead tunnel with the socket still open); the backend stalls
+    until the router's stall timeout trips."""
+
+
+class ReplicaSlowHealth(ReplicaFault):
+    """The replica's /health answers slower than the probe budget
+    (slow-loris): the probe must count as a failed poll."""
 
 
 # Status markers JAX device runtimes embed in XlaRuntimeError messages
@@ -121,6 +168,15 @@ FAULT_SITES = (
     "mixed-step",        # batched ragged prefill dispatch (admission wave)
     "decode-dispatch",   # fused decode / pp / verify step dispatch
     "sample",            # first-token sampling / blocking result fetch
+)
+
+# Replica-tier sites, guarded by the router's backends (one injector per
+# backend = per-replica scoping).  Each fires BEFORE the transport
+# operation it names, mirroring the engine-site contract.
+REPLICA_FAULT_SITES = (
+    "replica-connect",   # request send to the replica (connect refused)
+    "replica-stream",    # one SSE event read (mid-stream hang)
+    "replica-health",    # health probe (slow-loris /health)
 )
 
 
@@ -167,9 +223,9 @@ class FaultInjector:
     def inject(self, site: str, exc=TransientFault, *, nth: int = 1,
                times: int | None = 1, request_id: str | None = None,
                period: int = 0):
-        if site not in FAULT_SITES:
+        if site not in FAULT_SITES + REPLICA_FAULT_SITES:
             raise ValueError(f"unknown fault site {site!r}; "
-                             f"one of {FAULT_SITES}")
+                             f"one of {FAULT_SITES + REPLICA_FAULT_SITES}")
         self.specs.append(_FaultSpec(site=site, exc_factory=exc, nth=nth,
                                      times=times, request_id=request_id,
                                      period=period))

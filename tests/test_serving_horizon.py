@@ -185,3 +185,43 @@ def test_spec_k_composes_with_horizon(cfg_params):
         ServingEngine(cfg, params,
                       EngineConfig(spec_k=2, decode_horizon=4,
                                    step_token_budget=0))
+
+
+def test_pool_dry_requeue_drops_horizon_to_single_steps(cfg_params):
+    """A pool-dry-requeued request waiting in the engine-owned _pending
+    FIFO (with a free row!) must drop the fused horizon to single steps,
+    exactly like an inbox arrival would — pages freed by finishing rows
+    then come back at H=1 pace instead of the joiner waiting out full
+    H-step horizons (the fallback's contract: a joining row never waits
+    out a horizon)."""
+    cfg, params = cfg_params
+    # 3 usable pages (page 0 is scratch): A's 64-slot prompt takes 2 and
+    # its first decode page the 3rd -> pool dry with a row still free
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=2, max_seq_len=256, page_size=32, pool_pages=4,
+        prefill_bucket=32, decode_horizon=8))
+    a = Request(prompt_ids=list(RNG.integers(0, cfg.vocab_size, 64)),
+                max_new_tokens=24)   # 64+24 stays inside page 3
+    eng.submit(a)
+    for _ in range(200):     # prefill + the first fused decode tick
+        eng._tick()
+        if len(a.output_ids) >= 1:
+            break
+    assert len(eng.alloc.free) == 0          # pool is dry
+    assert eng._free_row() is not None       # but a row is free
+
+    b = Request(prompt_ids=list(RNG.integers(0, cfg.vocab_size, 32)),
+                max_new_tokens=4)
+    eng.submit(b)
+    eng._tick()              # b: inbox -> _pending, pool-dry requeue
+    assert len(eng._pending) == 1
+    eng._tick()              # a steady tick with b parked in _pending
+    assert eng.metrics["decode_horizon_effective"] == 1, (
+        "pool-dry joiner in _pending did not drop the horizon")
+
+    for _ in range(400):     # a finishes, pages free, b admits + finishes
+        eng._tick()
+        if b.finish_reason is not None:
+            break
+    assert a.finish_reason == "length" and len(a.output_ids) == 24
+    assert b.finish_reason == "length" and len(b.output_ids) == 4
