@@ -202,6 +202,203 @@ def bench_kv_storage(cfg, params, engine_config, concurrency: int,
         eng.stop()
 
 
+def bench_kv_spill(cfg, params, engine_config, concurrency: int,
+                   n_in: int, n_out: int, spill_bytes: int,
+                   n_waves: int = 4, seed: int = 13) -> dict:
+    """Host-RAM spill tier row: a REPEAT-WAVE workload that ALTERNATES
+    between two tenant prompt sets (waves A, B, A, B — the multi-tenant
+    shape) at a FIXED small device byte budget that cannot hold both
+    sets' prefix pages at once, so serving tenant B evicts tenant A's
+    cache before A returns.  The untiered engine (``spill_bytes=0``)
+    loses those pages and re-prefills every return; the tiered one
+    demotes them to host RAM and swaps them back.  Judged on the
+    window-scoped PAGE-level ``prefix_hit_rate`` over the repeat waves
+    (pages served from cache or swap-in / pages a perfect cache would
+    have served), with ``swap_in_p95_s`` bounding what a swap-in costs
+    (/health carries the same number)."""
+    from dataclasses import replace as _dc_replace
+
+    from ipex_llm_tpu.serving.engine import Request, ServingEngine
+
+    if n_waves < 3:
+        raise ValueError("bench_kv_spill needs n_waves >= 3: waves 0-1 "
+                         "seed the two tenant sets, the repeats from "
+                         "wave 2 on are the measured window")
+    rng = np.random.default_rng(seed)
+    sets = [[list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+             for _ in range(concurrency)] for _ in range(2)]
+    warm_prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+                    for _ in range(2)]
+    eng = ServingEngine(cfg, params,
+                        _dc_replace(engine_config,
+                                    kv_spill_bytes=spill_bytes)).start()
+    try:
+        _warm(eng, warm_prompts)
+        reqs: list = []
+        outs: dict[int, list[int]] = {}
+        t0 = time.perf_counter()
+        m0 = kv0 = None
+        for wave in range(n_waves):
+            if wave == 2:      # repeats start: window-scope from here
+                m0, kv0 = dict(eng.metrics), eng.kv_stats()
+            wave_reqs = [Request(prompt_ids=p, max_new_tokens=n_out)
+                         for p in sets[wave % 2]]
+            reqs.extend(wave_reqs)
+            _run_wave(eng, wave_reqs, outs, key_offset=wave * concurrency)
+        wall = time.perf_counter() - t0
+
+        m = eng.metrics
+        kv = eng.kv_stats()
+        total_tokens = sum(len(v) for v in outs.values())
+        ttfts = [r.first_token_s for r in reqs if r.first_token_s > 0]
+        # page-level hit rate over the repeat waves (2..n-1): pages
+        # served warm / pages a perfect cache would have served — each
+        # repeated prompt can share its (n_in - 1) // page_size
+        # registration-bounded pages
+        repeat_reqs = (n_waves - 2) * concurrency
+        ideal_pages = repeat_reqs * ((n_in - 1) // engine_config.page_size)
+        return {
+            "workload": "kv_spill",
+            "tiered": spill_bytes > 0,
+            "kv_spill_bytes": spill_bytes,
+            "kv_pool_bytes": engine_config.kv_pool_bytes,
+            "pages_total": kv["pages_total"],
+            "concurrency": concurrency,
+            "n_in": n_in,
+            "n_out": n_out,
+            "n_waves": n_waves,
+            "agg_tok_s": round(total_tokens / wall, 2),
+            "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+            "ttft_p95_s": round(_percentile(ttfts, 95), 4),
+            "prefix_hit_rate": round(
+                (m["prefix_pages_shared"] - m0["prefix_pages_shared"])
+                / max(ideal_pages, 1), 3),
+            "prefix_evictions": (kv["prefix_evictions"]
+                                 - kv0["prefix_evictions"]),
+            "swap_ins": kv.get("swap_ins", 0),
+            "swap_in_p95_s": kv.get("swap_in_p95_s", 0.0),
+            "spill_pages": kv.get("spill_pages", 0),
+            "spill_bytes_resident": kv.get("spill_bytes", 0),
+            "completed": sum(
+                1 for r in reqs if r.finish_reason in ("length", "stop")),
+        }
+    finally:
+        eng.stop()
+
+
+def bench_kv_spill_pair(cfg, params, engine_config, concurrency: int,
+                        n_in: int, n_out: int,
+                        spill_bytes: int = 1 << 28) -> list[dict]:
+    """The spill GATE pair: untiered vs tiered at the same fixed device
+    budget; the tiered row carries the verdict — it must sustain a
+    higher repeat-wave prefix hit rate than eviction left the untiered
+    engine, with a bounded (non-degenerate) swap-in latency surfaced."""
+    rows = [bench_kv_spill(cfg, params, engine_config, concurrency,
+                           n_in, n_out, sb) for sb in (0, spill_bytes)]
+    untiered, tiered = rows
+    tiered["gate"] = "PASS" if (
+        tiered["prefix_hit_rate"] > untiered["prefix_hit_rate"]
+        and tiered["swap_ins"] > 0
+        and 0.0 < tiered["swap_in_p95_s"] < 5.0) else "FAIL"
+    return rows
+
+
+def bench_disagg(cfg, params, engine_config, n_replicas: int = 3,
+                 n_reqs: int = 8, n_prefix: int = 48, n_tail: int = 4,
+                 n_out: int = 16, seed: int = 37,
+                 stream_timeout_s: float = 600.0) -> list[dict]:
+    """Disaggregated prefill/decode vs a monolithic fleet at EQUAL
+    replica count, under a prefill-heavy mix: every request shares a
+    long prompt prefix (the system-prompt / agentic shape) with a
+    distinct tail and a short output.
+
+    The monolithic fleet can serve the shared prefix from cache only on
+    the ONE replica prefix-affinity homes it to — the other replicas
+    either sit cold or recompute it — so the wave funnels through a
+    single engine's rows.  The disaggregated fleet computes the prefix
+    ONCE on the prefill replica and ships the pages to whichever decode
+    replica is least loaded, so every decode replica serves the prefix
+    warm and the wave spreads.  Judged on TTFT p50/p95 (down) with
+    aggregate tok/s held; handoff counters stamp how many page sets
+    moved and what they weighed on the wire (e5m2 codes)."""
+    from ipex_llm_tpu.serving.engine import ServingEngine
+    from ipex_llm_tpu.serving.router import InProcessBackend, RouterConfig
+
+    rng = np.random.default_rng(seed)
+    prefix = " ".join(str(x) for x in
+                      rng.integers(1, cfg.vocab_size, n_prefix))
+    prompts = [prefix + " " + " ".join(
+        str(x) for x in rng.integers(1, cfg.vocab_size, n_tail))
+        for _ in range(n_reqs)]
+    # distinct-prefix warm prompts: compile every engine without
+    # registering the measured prefix anywhere
+    warm = [" ".join(str(x) for x in
+                     rng.integers(1, cfg.vocab_size, n_prefix))
+            for _ in range(n_replicas + 1)]
+    tok = _BenchTok(cfg.vocab_size)
+    rows = []
+    for mode, roles, rc in (
+        ("monolithic", None,
+         RouterConfig(probe_interval_s=0.5,
+                      stall_timeout_s=stream_timeout_s)),
+        ("disagg", ["prefill"] + ["decode"] * (n_replicas - 1),
+         RouterConfig(probe_interval_s=0.5,
+                      stall_timeout_s=stream_timeout_s,
+                      disagg_prefill_chars=n_prefix)),
+    ):
+        async def mk_backends():
+            def factory():
+                return ServingEngine(cfg, params, engine_config).start()
+
+            bs = [InProcessBackend(factory, tok, "bench")
+                  for _ in range(n_replicas)]
+            for b in bs:
+                await b.start()
+            return bs
+
+        fleet = _RouterFleet(mk_backends, rc, roles=roles)
+        try:
+            for w in warm:
+                _sse_request(fleet.port, "/v1/completions",
+                             {"prompt": w, "max_tokens": 4,
+                              "temperature": 0.0}, stream_timeout_s)
+            t0 = time.perf_counter()
+            outs = _router_wave(fleet.port, prompts, n_out,
+                                concurrency=n_reqs,
+                                stream_timeout_s=stream_timeout_s)
+            wall = time.perf_counter() - t0
+            total_tokens = sum(len(o["text"].split()) for o in outs)
+            ttfts = [o["ttft_s"] for o in outs if o["ttft_s"] > 0]
+            c = fleet.router.counters
+            rows.append({
+                "workload": "disagg",
+                "mode": mode,
+                "replicas": n_replicas,
+                "n_reqs": n_reqs,
+                "n_prefix": n_prefix,
+                "n_tail": n_tail,
+                "n_out": n_out,
+                "agg_tok_s": round(total_tokens / wall, 2),
+                "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+                "ttft_p95_s": round(_percentile(ttfts, 95), 4),
+                "handoffs": c["handoffs"],
+                "handoff_failures": c["handoff_failures"],
+                "handoff_bytes": c["handoff_bytes"],
+                "completed": sum(1 for o in outs
+                                 if o["done"] and o["error"] is None),
+                "hangs": sum(1 for o in outs if o["hang"]),
+            })
+        finally:
+            fleet.stop()
+    mono, dis = rows
+    dis["gate"] = "PASS" if (
+        dis["ttft_p95_s"] < mono["ttft_p95_s"]
+        and dis["agg_tok_s"] >= 0.8 * mono["agg_tok_s"]
+        and dis["handoffs"] > 0
+        and dis["hangs"] == 0 and mono["hangs"] == 0) else "FAIL"
+    return rows
+
+
 def bench_spec(cfg, params, engine_config, concurrency: int, n_out: int,
                seed: int = 19) -> dict:
     """Speculative-decoding sweep row: an ACCEPT-FRIENDLY workload
@@ -292,7 +489,7 @@ class _RouterFleet:
     event-loop thread, so the (synchronous) bench drives it exactly the
     way clients do: over the router port."""
 
-    def __init__(self, backends_factory, router_config):
+    def __init__(self, backends_factory, router_config, roles=None):
         import asyncio
 
         from aiohttp import web
@@ -305,7 +502,7 @@ class _RouterFleet:
 
         async def boot():
             backends = await backends_factory()
-            holder["router"] = Router(backends, router_config)
+            holder["router"] = Router(backends, router_config, roles=roles)
             await holder["router"].start()
             runner = web.AppRunner(holder["router"].build_app())
             await runner.setup()
@@ -1042,6 +1239,20 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
         except Exception as e:  # noqa: BLE001
             print(f"serving_bench skip kv_storage={storage}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+    # host-RAM spill tier pair (BENCH_r11+): the SAME fixed device
+    # budget and a repeat-wave workload, untiered vs tiered — the tiered
+    # row must sustain the prefix hit rate the untiered one loses to
+    # eviction, with bounded swap-in latency (the gate is stamped on the
+    # tiered row).  Budget sized to just fit ONE wave of bf16 requests,
+    # like the kv_storage sweep, so the repeat waves generate real
+    # eviction pressure.
+    try:
+        out.extend(bench_kv_spill_pair(
+            cfg, params, _dc_replace(kv_ec, kv_storage="bf16"),
+            kv_c, kv_in, n_out))
+    except Exception as e:  # noqa: BLE001
+        print(f"serving_bench skip kv_spill: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     # speculative sweep at the sweep's top horizon (spec rides INSIDE the
     # fused tick — still one dispatch per tick): spec_k=0 is the in-run
     # baseline, spec_k 2/4 are judged against it on an accept-friendly
@@ -1086,6 +1297,19 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
                                        n_out=churn_out))
     except Exception as e:  # noqa: BLE001
         print(f"serving_bench skip replica_chaos: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # disaggregated prefill/decode vs monolithic at equal replica count
+    # (BENCH_r11+): prefill-heavy shared-prefix mix — the disagg fleet
+    # computes the prefix once and ships the pages (e5m2 wire) to the
+    # least-loaded decode replica, so TTFT p95 must drop with agg tok/s
+    # held (the gate rides the disagg row).  fp8 pools: the e5m2 wire
+    # codes ship natively, so the handoff is lossless.
+    try:
+        out.extend(bench_disagg(
+            cfg, params, _dc_replace(rep_ec, kv_storage="fp8"),
+            n_replicas=3, n_reqs=rep_reqs, n_out=churn_out))
+    except Exception as e:  # noqa: BLE001
+        print(f"serving_bench skip disagg: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     return out
 

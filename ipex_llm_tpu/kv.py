@@ -308,6 +308,32 @@ class PagedKVCache:
         vl = vl.at[pages, :, offs].set(self.encode(new_v))
         return kl, vl
 
+    def gather_pages(self, page_ids) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Pull whole pages out of the pool: ``page_ids`` [N] ->
+        (k [L, N, Hkv, page, D], v [L, N, Hkv, page, Dv]) in the pool's
+        own storage dtype — the export half of the transportable-KV
+        surface (host spill tier + disaggregated prefill/decode handoff,
+        serving/pagestore.py / serving/kv_transport.py).  Epoch-boundary
+        work by contract: callers gather at admission/eviction/finish
+        epochs, never inside the fused tick (JP106)."""
+        ids = jnp.asarray(page_ids, jnp.int32)
+        return self.k[:, ids], self.v[:, ids]
+
+    def scatter_pages(self, page_ids, k_pages: jnp.ndarray,
+                      v_pages: jnp.ndarray) -> "PagedKVCache":
+        """Write whole pages back into the pool (the import half):
+        ``page_ids`` [N], ``k_pages``/``v_pages`` shaped as
+        :meth:`gather_pages` returns.  Values are cast to the pool dtype
+        — a same-storage round trip is byte-identical (the spill tier's
+        swap-in contract); a widening/narrowing import (e5m2 wire onto a
+        bf16 pool) goes through the ordinary storage cast."""
+        ids = jnp.asarray(page_ids, jnp.int32)
+        return replace(
+            self,
+            k=self.k.at[:, ids].set(k_pages.astype(self.k.dtype)),
+            v=self.v.at[:, ids].set(v_pages.astype(self.v.dtype)),
+        )
+
     def gather_layer(self, kl: jnp.ndarray) -> jnp.ndarray:
         """Pool layer [P, H, page, D] -> head-major rows [R, H, maxP*page, D]
         (the raw layout cached_sdpa's decode path consumes)."""

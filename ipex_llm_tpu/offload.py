@@ -29,7 +29,6 @@ Throughput is PCIe/HBM-budget bound by construction; the point is the
 from __future__ import annotations
 
 import time
-from collections import OrderedDict
 from functools import partial
 from typing import Any
 
@@ -37,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ipex_llm_tpu.hostutil import h2d
+from ipex_llm_tpu.hostutil import HostLRU, h2d
 from ipex_llm_tpu.models.config import ModelConfig
 
 EXPERT_SLOTS = ("moe_gate_up", "moe_down")
@@ -56,35 +55,38 @@ def _qt_nbytes(tree) -> int:
 
 
 class ExpertStore:
-    """Host-RAM packed expert store with an HBM LRU cache."""
+    """Host-RAM packed expert store with an HBM LRU cache.
+
+    The byte-budget/eviction bookkeeping is ``hostutil.HostLRU`` — the
+    same helper the serving KV page store (serving/pagestore.py) budgets
+    its host spill tier with."""
 
     def __init__(self, host_slots: dict[str, Any], hbm_budget_bytes: int):
         self.host = host_slots            # slot -> stacked [L, E, ...] np QTensor
         self.budget = hbm_budget_bytes
-        self._cache: OrderedDict[tuple, Any] = OrderedDict()
-        self._used = 0
-        self.hits = 0
-        self.misses = 0
+        self._cache = HostLRU(hbm_budget_bytes)
+
+    @property
+    def hits(self) -> int:
+        return self._cache.hits
+
+    @property
+    def misses(self) -> int:
+        return self._cache.misses
 
     def get(self, layer: int, expert: int) -> dict[str, Any]:
         """Device QTensors {slot: qt} for one (layer, expert); LRU-cached."""
         key = (layer, expert)
-        if key in self._cache:
-            self._cache.move_to_end(key)
-            self.hits += 1
-            return self._cache[key]
-        self.misses += 1
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
         entry = {}
         for slot, stacked in self.host.items():
             per = jax.tree_util.tree_map(lambda a: a[layer, expert], stacked)
             # jaxlint: disable=JL001 -- zero-copy is intended here: host expert stacks are written once at split time and never mutated; copying would double peak host RAM per expert fetch
             entry[slot] = jax.device_put(per)   # async dispatch
         size = sum(_qt_nbytes(v) for v in entry.values())
-        while self._used + size > self.budget and self._cache:
-            _, old = self._cache.popitem(last=False)
-            self._used -= sum(_qt_nbytes(v) for v in old.values())
-        self._cache[key] = entry
-        self._used += size
+        self._cache.put(key, entry, size)
         return entry
 
     def prefetch(self, layer: int, experts) -> None:

@@ -26,6 +26,7 @@ except ImportError as _e:  # pragma: no cover
 from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
                                          ServingEngine, next_stream_item)
 from ipex_llm_tpu.serving.faults import EngineOverloaded
+from ipex_llm_tpu.serving.kv_transport import TransportError
 
 
 def _now() -> int:
@@ -62,7 +63,12 @@ class OpenAIServer:
         # OpenAI audio surface (the reference serves whisper through its
         # workers; SURVEY L6 lists the audio endpoint)
         self.asr = asr
-        self.app = web.Application()
+        # client_max_size: aiohttp's 1 MiB default would 413 every
+        # realistically-sized /kv/import page-set blob (one 7B-shaped
+        # bf16 page is ~64 MiB) and silently break the disaggregated
+        # handoff in production; 1 GiB bounds a whole long-prompt page
+        # set while still refusing pathological bodies
+        self.app = web.Application(client_max_size=1 << 30)
         self.app.router.add_post("/v1/chat/completions", self.chat)
         self.app.router.add_post("/v1/completions", self.completions)
         self.app.router.add_get("/v1/models", self.models)
@@ -71,6 +77,10 @@ class OpenAIServer:
         # TGI-protocol surface (reference serving/fastchat/tgi_api_server.py)
         self.app.router.add_post("/generate", self.tgi_generate)
         self.app.router.add_post("/generate_stream", self.tgi_generate_stream)
+        # transportable-KV surface (disaggregated prefill/decode): the
+        # router's handoff orchestration drives these two legs
+        self.app.router.add_post("/kv/prefill", self.kv_prefill)
+        self.app.router.add_post("/kv/import", self.kv_import)
         if asr is not None:
             self.app.router.add_post("/v1/audio/transcriptions",
                                      self.transcriptions)
@@ -507,6 +517,81 @@ class OpenAIServer:
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
+    # -- transportable KV (disaggregated prefill/decode) --------------------
+
+    def _body_prompt_ids(self, body: dict) -> list[int]:
+        """Token ids for any surface's prompt shape (chat messages /
+        completions prompt / TGI inputs) — the handoff legs must map a
+        body to the SAME ids the eventual completion request will, or
+        the exported pages' chain hashes won't match at admission."""
+        if body.get("messages"):
+            return self._encode_chat(body["messages"])
+        p = body.get("prompt", body.get("inputs", ""))
+        if isinstance(p, list):
+            p = p[0] if p else ""
+        return list(self.tok(str(p))["input_ids"])
+
+    async def kv_prefill(self, request):
+        """Handoff leg 1 (prefill replica): run the prompt through this
+        engine — a one-token greedy generation, i.e. the prefill plus a
+        throwaway first sample — then export the cached prefix pages as
+        a transportable page set (serving/kv_transport.py), returned as
+        application/octet-stream.  The decode replica that imports it
+        re-derives the first token itself from the uncovered tail.  422
+        when nothing is exportable (prompt under one page, or the pages
+        already evicted with no spill tier to serve them)."""
+        body = await request.json()
+        wire = str(body.get("wire", "auto"))
+        if wire not in ("auto", "fp8", "bf16"):
+            return web.json_response(
+                {"error": {"message": f"unknown wire format {wire!r}: "
+                                      "one of auto, fp8, bf16",
+                           "type": "invalid_request_error",
+                           "code": "bad_wire_format"}}, status=400)
+        ids = self._body_prompt_ids(body)
+        if not ids:
+            return web.json_response(
+                {"error": {"message": "empty prompt",
+                           "type": "invalid_request_error",
+                           "code": "empty_prompt"}}, status=400)
+        req = self._mk_request(
+            dict(body, max_tokens=1, temperature=0.0, stream=False), ids)
+        self._submit(req)
+        while await self._next_tok(req) is not None:
+            pass
+        if _req_failed(req):
+            return self._error_response(req)
+        loop = asyncio.get_running_loop()
+        blob = await loop.run_in_executor(
+            None, self.engine.export_prefix, ids, wire)
+        if blob is None:
+            return web.json_response(
+                {"error": {"message": "no full prefix page cached for "
+                                      "this prompt",
+                           "type": "invalid_request_error",
+                           "code": "nothing_to_export"}}, status=422)
+        return web.Response(body=blob,
+                            content_type="application/octet-stream",
+                            headers={"X-KV-Tokens": str(len(ids))})
+
+    async def kv_import(self, request):
+        """Handoff leg 2 (decode replica): verify + import a page set
+        into this engine's pool and prefix cache, so the completion
+        routed here next prefills only the uncovered tail.  Malformed
+        blobs are 400 (``TransportError`` — unverified bytes are never
+        scattered)."""
+        blob = await request.read()
+        loop = asyncio.get_running_loop()
+        try:
+            res = await loop.run_in_executor(
+                None, self.engine.import_pages, blob)
+        except TransportError as e:
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error",
+                           "code": "bad_page_set"}}, status=400)
+        return web.json_response(res)
+
     # -- TGI protocol -------------------------------------------------------
 
     def _tgi_request(self, body: dict) -> Request:
@@ -752,6 +837,13 @@ def main(argv=None):
                          "as BYTES / page_bytes(model, --kv-storage), so "
                          "fp8 automatically holds 2x the pages.  0 = size "
                          "in pages (the auto heuristic)")
+    ap.add_argument("--kv-spill-bytes", type=int, default=0,
+                    metavar="BYTES",
+                    help="host-RAM KV spill tier budget: prefix pages "
+                         "evicted under pool pressure (and finished "
+                         "rows' decode pages) demote to a host LRU and "
+                         "swap back on the next prefix hit instead of "
+                         "being recomputed.  0 = off")
     ap.add_argument("--max-queue", type=int, default=256,
                     help="bounded admission queue: submissions beyond this "
                          "many waiting requests are load-shed with HTTP "
@@ -779,6 +871,7 @@ def main(argv=None):
                      step_token_budget=args.step_token_budget,
                      kv_storage=args.kv_storage,
                      kv_pool_bytes=args.kv_pool_bytes,
+                     kv_spill_bytes=args.kv_spill_bytes,
                      max_queue=args.max_queue,
                      request_deadline_s=args.request_deadline,
                      max_step_retries=args.max_step_retries),

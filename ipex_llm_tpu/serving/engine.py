@@ -84,6 +84,17 @@ class EngineConfig:
     # and operators size the pool in the unit they actually provision
     # (HBM bytes).  Overrides pool_pages.  0 = pool_pages/auto sizing.
     kv_pool_bytes: int = 0
+    # host-RAM KV spill tier (serving/pagestore.py): when > 0, a prefix
+    # page evicted under pool pressure — and a finished row's decode
+    # pages (the multi-turn follow-up's prefix) — DEMOTES into a
+    # byte-budgeted host LRU instead of being lost, and swaps back into
+    # the pool on the next prefix hit: a PCIe copy through the audited
+    # hostutil.h2d/d2h boundary instead of a recompute.  Swapped-in
+    # pages are byte-identical to ones that never left the pool.  All
+    # spill/swap work happens at epoch boundaries (allocation, admission,
+    # finish), never inside the fused tick — JP106's one-dispatch tick
+    # is untouched.  0 = evictions stay losses (the pre-spill engine).
+    kv_spill_bytes: int = 0
     prefill_bucket: int = 128   # chunked-prefill chunk length
     # speculative serving (reference ipex_llm_worker.py:57 `speculative`
     # load flag): >0 enables prompt-lookup speculative decode steps — each
@@ -208,12 +219,19 @@ class Request:
 
 class PageAllocator:
     """Host-side page pool bookkeeping: free list, refcounts, and the
-    chained-hash prefix cache (LRU-evicted when the pool runs dry)."""
+    chained-hash prefix cache (LRU-evicted when the pool runs dry).
 
-    def __init__(self, n_pages: int):
+    ``spill``: optional callback ``spill([(key, pid), ...])`` invoked
+    BEFORE a batch of cached prefix pages is dropped — the engine's hook
+    into the host-RAM page store, turning an eviction from a loss into a
+    demotion.  Batched so an allocation burst (``reserve``) pays ONE
+    device gather + sync for all its evictions instead of one each."""
+
+    def __init__(self, n_pages: int, spill=None):
         # page 0 is the device scratch page (kv.PagedKVCache.update_layer
         # routes out-of-range/pad writes there) — never handed out
         self.n_pages = n_pages
+        self.spill = spill
         self.free: list[int] = list(range(n_pages - 1, 0, -1))
         self.ref = np.zeros((n_pages,), np.int32)
         # prefix cache: chain-hash -> page id; insertion order ~ LRU
@@ -221,15 +239,27 @@ class PageAllocator:
         self._page_key: dict[int, bytes] = {}
         # pool-pressure trace: cached prefix pages dropped to satisfy new
         # allocations (each one is a future prefix miss a bigger pool —
-        # or a narrower storage — would have kept)
+        # or a narrower storage — would have kept; with a spill tier the
+        # page demotes to host RAM instead of being lost, so the counter
+        # becomes "demotions", not "losses" — the router's affinity
+        # freshness check reads it together with the spill block)
         self.prefix_evictions = 0
 
     def alloc(self) -> int | None:
-        if not self.free and not self._evict_one():
+        if not self.free and not self._evict(1):
             return None
         pid = self.free.pop()
         self.ref[pid] = 1
         return pid
+
+    def reserve(self, n: int):
+        """Pre-evict so the next ``n`` allocations are covered: exactly
+        the pages lazy per-alloc eviction would drop (same LRU order,
+        same count), but spilled in ONE batch — an allocation burst
+        under pressure pays one device gather, not one per page."""
+        short = n - len(self.free)
+        if short > 0:
+            self._evict(short)
 
     def addref(self, pid: int):
         self.ref[pid] += 1
@@ -239,16 +269,29 @@ class PageAllocator:
         if self.ref[pid] == 0:
             self.free.append(pid)
 
-    def _evict_one(self) -> bool:
-        """Drop the least-recently-used prefix page held only by the cache."""
+    def _evict(self, n: int) -> int:
+        """Drop up to ``n`` least-recently-used prefix pages held only
+        by the cache, spilling them to the host tier first (one batched
+        callback) when one is wired.  Returns how many were dropped."""
+        picks = []
         for key, pid in self.prefix.items():
             if self.ref[pid] == 1:  # only the cache references it
-                del self.prefix[key]
-                del self._page_key[pid]
-                self.decref(pid)
-                self.prefix_evictions += 1
-                return True
-        return False
+                picks.append((key, pid))
+                if len(picks) == n:
+                    break
+        if not picks:
+            return 0
+        if self.spill is not None:
+            # before the bookkeeping drop, while the pages are still
+            # owned: a raise here (injected fault) leaves every cache
+            # entry intact for the retry
+            self.spill(picks)
+        for key, pid in picks:
+            del self.prefix[key]
+            del self._page_key[pid]
+            self.decref(pid)
+            self.prefix_evictions += 1
+        return len(picks)
 
     def register_prefix(self, key: bytes, pid: int):
         if key in self.prefix or pid in self._page_key:
@@ -919,6 +962,9 @@ class ServingEngine:
         if self.ec.kv_pool_bytes < 0:
             raise ValueError("kv_pool_bytes must be >= 0 (0 = size the "
                              "pool in pages via pool_pages)")
+        if self.ec.kv_spill_bytes < 0:
+            raise ValueError("kv_spill_bytes must be >= 0 (0 disables "
+                             "the host-RAM KV spill tier)")
         # KV storage axis: bytes ONE page costs at this model shape and
         # storage width — the unit kv_pool_bytes divides by (validates
         # kv_storage, raising with the valid names)
@@ -1000,7 +1046,17 @@ class ServingEngine:
                 "decode_horizon > 1 with spec_k > 0 needs the fused "
                 "engine (step_token_budget > 0 and no pp mesh); the "
                 "host-walk verify path cannot fuse horizons")
-        self.alloc = PageAllocator(self.ec.n_pages)
+        # host-RAM KV spill tier: evicted prefix pages (and finished
+        # rows' decode pages) demote here instead of being lost, and
+        # swap back on a prefix hit (serving/pagestore.py)
+        self.pagestore = None
+        if self.ec.kv_spill_bytes > 0:
+            from ipex_llm_tpu.serving.pagestore import PageStore
+
+            self.pagestore = PageStore(self.ec.kv_spill_bytes)
+        self.alloc = PageAllocator(
+            self.ec.n_pages,
+            spill=self._spill_pages if self.pagestore is not None else None)
         self.tables = np.full((r, self.ec.max_pages), -1, np.int32)
         # block-table dirty-row tracking: every host-side mutation of
         # ``self.tables`` records its row here, and device syncs scatter
@@ -1022,6 +1078,13 @@ class ServingEngine:
         self._row_keys: dict[int, list[bytes]] = {}   # row -> prefix hashes
         self.key = jax.random.PRNGKey(0)
         self._inbox: "queue.Queue[Request]" = queue.Queue()
+        # engine-thread host operations (KV page-set export/import):
+        # closures enqueued here run BETWEEN transactional ticks on the
+        # engine thread — over committed state, with exclusive access to
+        # the pool/allocator/prefix cache — via run_on_engine().  Gathers
+        # and scatters they perform are epoch-boundary work, never tick
+        # work (JP106's one-dispatch tick is untouched).
+        self._host_ops: "queue.Queue[tuple]" = queue.Queue()
         # host-side FIFO the engine thread owns: submissions drain from the
         # (cross-thread) inbox into this deque, admission pops its head,
         # and a pool-dry requeue puts the head BACK AT THE HEAD — the old
@@ -1132,6 +1195,50 @@ class ServingEngine:
         """Requests waiting for a row (inbox + pending, not in-flight)."""
         return self._inbox.qsize() + len(self._pending)
 
+    def run_on_engine(self, fn, timeout: float = 120.0):
+        """Run ``fn()`` on the engine thread BETWEEN transactional ticks
+        — over committed state, with exclusive pool/allocator/prefix-
+        cache access — and return its result (raising whatever ``fn``
+        raised).  The transport surface (export_prefix / import_pages)
+        routes through here so its gathers/scatters are epoch-boundary
+        work that can never interleave with a half-done tick.  Called
+        FROM the engine thread, or with no live engine thread (tests
+        driving ``_tick`` directly), it runs inline."""
+        t = self._thread
+        if (t is None or not t.is_alive()
+                or threading.current_thread() is t):
+            return fn()
+        box: "queue.Queue" = queue.Queue()
+        self._host_ops.put((fn, box))
+        self._work.set()
+        try:
+            ok, res = box.get(timeout=timeout)
+        except queue.Empty:
+            # the engine thread died/stopped with the op still queued
+            # (the loop's exit drain failures-out stragglers, but a
+            # thread killed hard never reaches it): fail clean instead
+            # of leaking a bare queue.Empty to the HTTP handler
+            raise RuntimeError(
+                "engine did not service the host operation "
+                f"within {timeout}s (stopped or wedged)") from None
+        if not ok:
+            raise res
+        return res
+
+    def _drain_host_ops(self):
+        """Run queued host operations at the tick boundary: they see
+        only committed state, and what they mutate IS committed state
+        for the next tick's checkpoint."""
+        while True:
+            try:
+                fn, box = self._host_ops.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box.put((True, fn()))
+            except Exception as e:      # delivered to the waiting caller
+                box.put((False, e))
+
     def kv_stats(self) -> dict:
         """KV-pool observability for /health and the bench sweeps: what
         the pool costs (storage format, page/pool bytes), how full it is,
@@ -1139,7 +1246,7 @@ class ServingEngine:
         failures that forced a clamp) — the numbers the fp8-vs-bf16
         fixed-byte-budget story is judged on."""
         a = self.alloc
-        return {
+        out = {
             "storage": self.ec.kv_storage,
             "page_size": self.ec.page_size,
             "pages_total": a.n_pages,       # page 0 = reserved scratch
@@ -1152,6 +1259,15 @@ class ServingEngine:
             "alloc_fail_clamps": self.metrics.get("alloc_fail_clamps", 0),
             "horizon_clamped": self.metrics.get("horizon_clamped", 0),
         }
+        # spill-tier block (flat numeric keys ride the replica /metrics
+        # exposition and the router's fleet aggregation unchanged; the
+        # router's affinity freshness check reads spill_enabled /
+        # spill_pages / swap_in_hit_rate to tell a demotion from a loss)
+        if self.pagestore is not None:
+            out.update(self.pagestore.stats())
+        else:
+            out["spill_enabled"] = False
+        return out
 
     def spec_stats(self) -> dict:
         """Speculative-decoding observability for /health and the bench
@@ -1281,6 +1397,11 @@ class ServingEngine:
             "metrics": dict(self.metrics),
             "ttfts": list(self._ttfts),
             "spec_window": list(self._spec_window),
+            # the spill tier mutates mid-tick (evictions demote pages,
+            # swap-ins consume entries): bookkeeping-only snapshot, so a
+            # rolled-back tick leaves the store residue-free
+            "pagestore": (self.pagestore.snapshot()
+                          if self.pagestore is not None else None),
             "reqs": [(r, len(r.output_ids), len(r.logprobs),
                       r.finish_reason, r.first_token_s) for r in reqs],
         }
@@ -1308,6 +1429,12 @@ class ServingEngine:
         self.alloc.prefix = OrderedDict(prefix)
         self.alloc._page_key = dict(pkey)
         self.alloc.prefix_evictions = evictions
+        if self.pagestore is not None and snap["pagestore"] is not None:
+            # undone spills vanish, consumed swap-in entries come back;
+            # data a doomed swap-in scattered into a (now re-freed) pool
+            # page is unreferenced garbage, exactly like a rolled-back
+            # tick's KV writes past the committed row_lens
+            self.pagestore.restore(snap["pagestore"])
         self.key = snap["key"]
         # the rolling TTFT window reverts too: a first token recorded by
         # the doomed tick (or a bisection probe) was never emitted, and the
@@ -1529,6 +1656,11 @@ class ServingEngine:
         """
         self._fault_point("page-alloc", rows=(row,), reqs=(req,))
         need = min(-(-upto_slot // self.ec.page_size), self.ec.max_pages)
+        missing = sum(1 for j in range(need) if self.tables[row, j] < 0)
+        if missing > 1:
+            # batch the burst's evictions: one spill gather instead of
+            # one per page (drops the same pages lazy eviction would)
+            self.alloc.reserve(missing)
         for j in range(need):
             if self.tables[row, j] < 0:
                 pid = self.alloc.alloc()
@@ -1553,6 +1685,209 @@ class ServingEngine:
                 self.alloc.decref(pid)
                 self.tables[row, j] = -1
                 self._dirty_tables.add(row)
+
+    # -- host-RAM spill tier (serving/pagestore.py) -------------------------
+
+    def _spill_pages(self, pairs):
+        """PageAllocator eviction hook: demote a batch of cache-owned
+        prefix pages' bytes to the host store just before their pool
+        slots are recycled — ONE gather + one blocking sync for the
+        whole batch (``PageAllocator.reserve`` batches an allocation
+        burst's evictions into a single call here).  Epoch-boundary work
+        (page allocation is an epoch); a raise fires before any store
+        mutation, so rollback + retry see every cache entry intact."""
+        self._fault_point("spill-store")
+        pids = np.asarray([p for _, p in pairs], np.int32)
+        k_pages, v_pages = self.cache.gather_pages(pids)
+        t0 = time.perf_counter()
+        # jaxlint: disable=JL002 -- designed epoch-boundary sync: the batch's bytes must reach host RAM before the pool slots are recycled (the demotion itself)
+        k_np = d2h(k_pages)
+        v_np = d2h(v_pages)  # jaxlint: disable=JL002 -- same designed spill sync; already blocked on k_np above
+        self._count_sync(time.perf_counter() - t0)
+        for i, (key, _) in enumerate(pairs):
+            self.pagestore.spill(key,
+                                 np.ascontiguousarray(k_np[:, i]),
+                                 np.ascontiguousarray(v_np[:, i]))
+
+    def _swap_in(self, key: bytes) -> int | None:
+        """Promote a spilled page back into the pool: allocate a slot
+        (which may itself demote colder pages), scatter the stored bytes
+        through the h2d boundary, and register the page cache-owned —
+        byte-identical to one that never left the pool.  Returns the pid
+        (the admission loop addrefs it exactly like a prefix hit) or
+        None on a store miss / dry pool."""
+        entry = self.pagestore.take(key)
+        if entry is None:
+            return None
+        self._fault_point("swap-in")
+        pid = self.alloc.alloc()
+        if pid is None:
+            self.pagestore.untake(key, entry)   # failed promotion
+            return None
+        t0 = time.perf_counter()
+        k_np, v_np = entry
+        self.cache = self.cache.scatter_pages(
+            np.asarray([pid], np.int32), h2d(k_np[:, None]),
+            h2d(v_np[:, None]))
+        self.pagestore.record_swap_in(time.perf_counter() - t0)
+        # transfer alloc()'s caller reference to the prefix cache
+        # (register_prefix addrefs, so drop ours): the page ends
+        # cache-owned at ref 1 — exactly a registered page no row holds
+        self.alloc.register_prefix(key, pid)
+        self.alloc.decref(pid)
+        return pid
+
+    def _spill_finished_row(self, row: int, req: Request):
+        """Cold-row spill at finish: a cleanly-finished row's decode
+        pages hold the KV of prompt+output — the prefix a multi-turn
+        follow-up request will arrive with.  Full pages past the prompt
+        registration bound demote to the host store (keyed by the chain
+        hash over prompt+output, the identity a future prompt computes)
+        just before ``_finish`` recycles the pool slots; the device
+        prefix cache itself keeps only prompt pages, exactly as before.
+        Valid KV covers every prompt slot plus outputs[:-1] — the last
+        emitted token's KV would have been written by the step that
+        never ran — so only pages fully inside that bound spill."""
+        ids = np.concatenate([
+            np.asarray(req.prompt_ids, np.int32),
+            np.asarray(req.output_ids, np.int32)])
+        n_p = len(req.prompt_ids)
+        ps = self.ec.page_size
+        n_valid = n_p + max(len(req.output_ids) - 1, 0)
+        reg = (n_p - 1) // ps                   # _finish_prompt's bound
+        hi = min(n_valid // ps, self.ec.max_pages)
+        if hi <= reg:
+            return
+        keys = _chain_hashes(ids[: hi * ps], ps)
+        picks = [(keys[j], int(self.tables[row, j]))
+                 for j in range(reg, hi)
+                 if int(self.tables[row, j]) >= 0
+                 and keys[j] not in self.alloc.prefix]
+        if not picks:
+            return
+        self._fault_point("spill-store", rows=(row,))
+        pids = np.asarray([p for _, p in picks], np.int32)
+        k_pages, v_pages = self.cache.gather_pages(pids)
+        t0 = time.perf_counter()
+        # jaxlint: disable=JL002 -- designed finish-epoch sync: one batched gather spills the finished row's pages before their pool slots are recycled
+        k_np = d2h(k_pages)
+        v_np = d2h(v_pages)  # jaxlint: disable=JL002 -- rides the same designed finish-epoch sync; already blocked on k_np above
+        self._count_sync(time.perf_counter() - t0)
+        for i, (key, _) in enumerate(picks):
+            self.pagestore.spill(key,
+                                 np.ascontiguousarray(k_np[:, i]),
+                                 np.ascontiguousarray(v_np[:, i]))
+
+    # -- transportable page sets (serving/kv_transport.py) ------------------
+
+    def _pool_shape(self) -> dict:
+        l, _, h, ps, d = self.cache.k.shape
+        return {"n_layers": l, "n_kv_heads": h, "page_size": ps,
+                "head_dim": d, "v_head_dim": self.cache.v.shape[4]}
+
+    def export_prefix(self, prompt_ids, wire: str = "auto") -> bytes | None:
+        """Serialize the cached prefix pages covering ``prompt_ids`` as
+        a transportable page set — the disaggregated prefill/decode
+        handoff's export half.  Walks the chained-hash prefix exactly
+        like admission does, serving each page from the device prefix
+        cache or the host spill tier, and stops at the first miss (a
+        chain is only useful up to its unbroken head).  ``wire="auto"``
+        ships e5m2 codes — an fp8 pool's codes natively (lossless), a
+        bf16 pool recoded (half the handoff bytes, lossy exactly like
+        fp8 KV storage; pass ``wire="bf16"`` for bit-exact bf16
+        handoff).  Returns None when no full page is cached.  Runs on
+        the engine thread between ticks (epoch-boundary gathers, not
+        tick work — JP106 unchanged)."""
+        ids = np.asarray(list(prompt_ids), np.int32)
+        return self.run_on_engine(lambda: self._export_prefix_op(ids, wire))
+
+    def _export_prefix_op(self, ids: np.ndarray, wire: str):
+        from ipex_llm_tpu.serving import kv_transport
+
+        if wire == "auto":
+            # e5m2 on the wire: native codes for fp8 pools, recoded
+            # (halved) handoff bytes for bf16 pools
+            wire = "fp8"
+        n_p = len(ids)
+        keys = _chain_hashes(ids, self.ec.page_size)
+        shareable = min(len(keys), (n_p - 1) // self.ec.page_size)
+        order: list[tuple[str, bytes, Any]] = []
+        for key in keys[:shareable]:
+            pid = self.alloc.prefix.get(key)
+            if pid is not None:
+                order.append(("dev", key, pid))
+                continue
+            entry = (self.pagestore.peek(key)
+                     if self.pagestore is not None else None)
+            if entry is None:
+                break
+            order.append(("host", key, entry))
+        if not order:
+            return None
+        self._fault_point("kv-export")
+        pids = np.asarray([p for kind, _, p in order if kind == "dev"],
+                          np.int32)
+        if len(pids):
+            k_all, v_all = self.cache.gather_pages(pids)
+            t0 = time.perf_counter()
+            # jaxlint: disable=JL002 -- designed export sync: one batched gather materializes the page set for serialization (between-ticks host op)
+            k_np = d2h(k_all)
+            v_np = d2h(v_all)  # jaxlint: disable=JL002 -- rides the same designed export sync; already blocked on k_np above
+            self._count_sync(time.perf_counter() - t0)
+        pages, di = [], 0
+        for kind, key, payload in order:
+            if kind == "dev":
+                pages.append((key, k_np[:, di], v_np[:, di]))
+                di += 1
+            else:
+                pages.append((key, payload[0], payload[1]))
+        self.metrics["kv_pages_exported"] = (
+            self.metrics.get("kv_pages_exported", 0) + len(pages))
+        return kv_transport.pack_pages(self._pool_shape(), pages,
+                                       wire=wire)
+
+    def import_pages(self, blob: bytes) -> dict:
+        """Import a transportable page set into this engine's pool and
+        prefix cache — the handoff's import half.  The blob is verified
+        first (``TransportError`` on corruption / truncation / version /
+        pool-shape mismatch — unverified bytes are never scattered),
+        then pages land in chain order: already-cached keys are skipped,
+        the rest are allocated (evicting/spilling under pressure like
+        any allocation), scattered through the h2d boundary, and
+        registered cache-owned — so the next admitted request with this
+        prompt prefix-hits them like home-grown pages and joins the
+        fused tick with only the uncovered tail left to prefill.  A dry
+        pool stops the import early (what fit is registered).  Runs on
+        the engine thread between ticks."""
+        return self.run_on_engine(lambda: self._import_pages_op(blob))
+
+    def _import_pages_op(self, blob: bytes) -> dict:
+        from ipex_llm_tpu.serving import kv_transport
+
+        meta, pages = kv_transport.unpack_pages(blob)
+        kv_transport.check_pool_shape(meta, **self._pool_shape())
+        self._fault_point("kv-import")
+        t0 = time.perf_counter()
+        imported = skipped = 0
+        for key, k_page, v_page in pages:
+            if key in self.alloc.prefix:
+                skipped += 1
+                continue
+            pid = self.alloc.alloc()
+            if pid is None:
+                break                       # dry pool: keep what fit
+            self.cache = self.cache.scatter_pages(
+                np.asarray([pid], np.int32),
+                h2d(k_page[:, None]), h2d(v_page[:, None]))
+            self.alloc.register_prefix(key, pid)
+            self.alloc.decref(pid)          # cache-owned at ref 1
+            imported += 1
+        self.metrics["kv_pages_imported"] = (
+            self.metrics.get("kv_pages_imported", 0) + imported)
+        return {"imported_pages": imported, "skipped_pages": skipped,
+                "tokens_covered": (imported + skipped) * self.ec.page_size,
+                "wire": meta["wire"],
+                "import_s": round(time.perf_counter() - t0, 5)}
 
     # -- device-resident engine state ---------------------------------------
 
@@ -1716,6 +2051,11 @@ class ServingEngine:
             shared = 0
             for i in range(shareable):
                 pid = self.alloc.lookup_prefix(keys[i])
+                if pid is None and self.pagestore is not None:
+                    # spill-tier promotion: a page the pool evicted (or
+                    # a finished row's decode page) swaps back in — a
+                    # PCIe copy instead of re-prefilling the chunk
+                    pid = self._swap_in(keys[i])
                 if pid is None:
                     break
                 self.alloc.addref(pid)
@@ -1871,6 +2211,14 @@ class ServingEngine:
         # overwriting it here would misreport the finish reason
         if req.finish_reason is None:
             req.finish_reason = reason
+        if (self.pagestore is not None and req.output_ids
+                and req.finish_reason in ("stop", "length",
+                                          "stop_string")):
+            # cold-row spill: a cleanly-finished row's decode KV is the
+            # multi-turn follow-up's prefix — demote it before the pool
+            # slots are recycled (aborts/errors spill nothing: their KV
+            # may be incomplete)
+            self._spill_finished_row(row, req)
         self._queue_put(req, None)
         self.rows[row] = None
         self.row_lens[row] = 0
@@ -2091,6 +2439,7 @@ class ServingEngine:
 
     def _loop(self):
         while not self._stop.is_set():
+            self._drain_host_ops()
             try:
                 committed = self._tick()
                 # a committed tick means the engine recovered: clear the
@@ -2100,6 +2449,16 @@ class ServingEngine:
                     self.metrics["last_error"] = ""
             except Exception as exc:  # recovery machinery itself failed
                 self._fail_all(exc)
+        # shutdown drain: host ops enqueued after the loop's last drain
+        # must not leave their callers blocked until timeout — fail them
+        # with a clean "engine stopped" instead
+        while True:
+            try:
+                _, box = self._host_ops.get_nowait()
+            except queue.Empty:
+                break
+            box.put((False, RuntimeError(
+                "engine stopped before servicing the host operation")))
 
     def _step_once(self):
         """Scheduler: three regimes, ONE dispatch per tick.  Admission

@@ -168,6 +168,11 @@ FAULT_SITES = (
     "mixed-step",        # batched ragged prefill dispatch (admission wave)
     "decode-dispatch",   # fused decode / pp / verify step dispatch
     "sample",            # first-token sampling / blocking result fetch
+    # spill-tier / transport sites (host-RAM page store + kv_transport):
+    "spill-store",       # page demotion to the host store (pre-gather)
+    "swap-in",           # page promotion back into the pool (pre-scatter)
+    "kv-export",         # prefix page-set serialization (pre-gather)
+    "kv-import",         # page-set import into the pool (pre-scatter)
 )
 
 # Replica-tier sites, guarded by the router's backends (one injector per
@@ -177,6 +182,11 @@ REPLICA_FAULT_SITES = (
     "replica-connect",   # request send to the replica (connect refused)
     "replica-stream",    # one SSE event read (mid-stream hang)
     "replica-health",    # health probe (slow-loris /health)
+    # disaggregated prefill/decode: fires before each handoff leg
+    # (/kv/prefill on the prefill replica, /kv/import on the decode
+    # replica) — a mid-handoff death is a zero-delivery failover: the
+    # client saw nothing, the router falls back to the monolithic path
+    "replica-handoff",
 )
 
 

@@ -1,0 +1,164 @@
+"""Transportable KV page sets: the wire format for moving paged KV
+between engines.
+
+One serialization serves both halves of ROADMAP item 1's substrate: the
+host spill tier persists pool bytes locally (serving/pagestore.py keeps
+them as arrays — this module is for crossing a process/host boundary),
+and **disaggregated prefill/decode** ships a finished prefill's pages
+from a prefill-heavy replica to a decode-heavy one (the router's handoff
+orchestration, serving/router.py), where the importer seeds its prefix
+cache and the admitted request prefills only the uncovered tail.
+
+Format (little-endian, versioned, checksummed):
+
+    magic   8  b"IPLTKV01"
+    hlen    4  u32: header length
+    header     JSON: version, model/pool shape (n_layers, n_kv_heads,
+               page_size, head_dim, v_head_dim), wire storage
+               ("fp8" e5m2 codes | "bf16"), page keys (hex chain
+               hashes, in chain order), per-page k/v byte sizes
+    payload    for each page, k bytes then v bytes ([L, Hkv, page, D]
+               row-major in the wire dtype)
+    digest  32 sha256 over everything before it
+
+``wire="fp8"`` serializes e5m2 codes — HALF the handoff bytes of a bf16
+pool (an fp8 pool's codes ship natively, losslessly).  Recoding a bf16
+pool to e5m2 wire is lossy exactly like fp8 KV storage is; fleets that
+need bit-exact bf16 handoff pass ``wire="bf16"``.
+
+Every malformed blob — truncated, bit-flipped, wrong magic, unknown
+version, or shape-incompatible with the importing pool — raises
+``TransportError``; the importer never scatters unverified bytes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+
+import numpy as np
+
+from ipex_llm_tpu.kv import kv_storage_dtype
+
+__all__ = ["TransportError", "pack_pages", "unpack_pages", "WIRE_MAGIC"]
+
+WIRE_MAGIC = b"IPLTKV01"
+WIRE_VERSION = 1
+_DIGEST_LEN = 32
+
+
+class TransportError(ValueError):
+    """A KV page blob that must not be imported: truncated, corrupted
+    (checksum mismatch), wrong format/version, or shaped for a different
+    pool than the importer's."""
+
+
+def _np_dtype(storage: str) -> np.dtype:
+    # jax's storage dtypes are ml_dtypes-backed numpy dtypes, so they
+    # round-trip through tobytes/frombuffer bitwise
+    return np.dtype(kv_storage_dtype(storage))
+
+
+def pack_pages(shape: dict, pages, wire: str = "fp8") -> bytes:
+    """Serialize ``pages`` — an iterable of ``(key_bytes, k_page,
+    v_page)`` with arrays shaped [L, Hkv, page, D] in either storage
+    dtype — under ``shape`` (n_layers / n_kv_heads / page_size /
+    head_dim / v_head_dim), recoding to the ``wire`` storage."""
+    wdt = _np_dtype(wire)
+    keys, chunks = [], []
+    k_bytes = v_bytes = 0
+    for key, k_page, v_page in pages:
+        k_w = np.ascontiguousarray(np.asarray(k_page).astype(wdt))
+        v_w = np.ascontiguousarray(np.asarray(v_page).astype(wdt))
+        k_bytes, v_bytes = k_w.nbytes, v_w.nbytes
+        keys.append(key.hex())
+        chunks.append(k_w.tobytes())
+        chunks.append(v_w.tobytes())
+    header = json.dumps({
+        "version": WIRE_VERSION,
+        "wire": wire,
+        "n_layers": int(shape["n_layers"]),
+        "n_kv_heads": int(shape["n_kv_heads"]),
+        "page_size": int(shape["page_size"]),
+        "head_dim": int(shape["head_dim"]),
+        "v_head_dim": int(shape["v_head_dim"]),
+        "keys": keys,
+        "k_page_bytes": k_bytes,
+        "v_page_bytes": v_bytes,
+    }, sort_keys=True).encode()
+    body = (WIRE_MAGIC + struct.pack("<I", len(header)) + header
+            + b"".join(chunks))
+    return body + hashlib.sha256(body).digest()
+
+
+def unpack_pages(blob: bytes):
+    """Verify + parse a blob: returns ``(meta, [(key_bytes, k_page,
+    v_page)])`` with arrays in the wire dtype, shaped [L, Hkv, page, D].
+    Raises :class:`TransportError` on any malformation."""
+    if len(blob) < len(WIRE_MAGIC) + 4 + _DIGEST_LEN:
+        raise TransportError(
+            f"blob too short ({len(blob)} bytes) to be a KV page set")
+    if blob[: len(WIRE_MAGIC)] != WIRE_MAGIC:
+        raise TransportError("bad magic: not a KV page-set blob")
+    body, digest = blob[:-_DIGEST_LEN], blob[-_DIGEST_LEN:]
+    if hashlib.sha256(body).digest() != digest:
+        raise TransportError("checksum mismatch: corrupted or truncated "
+                             "KV page set")
+    (hlen,) = struct.unpack_from("<I", body, len(WIRE_MAGIC))
+    hstart = len(WIRE_MAGIC) + 4
+    if hstart + hlen > len(body):
+        raise TransportError("truncated header")
+    try:
+        meta = json.loads(body[hstart: hstart + hlen])
+    except ValueError as e:
+        raise TransportError(f"unparseable header: {e}") from None
+    if meta.get("version") != WIRE_VERSION:
+        raise TransportError(
+            f"unsupported KV transport version {meta.get('version')!r} "
+            f"(this build speaks {WIRE_VERSION})")
+    try:
+        wdt = _np_dtype(meta["wire"])
+        keys = [bytes.fromhex(k) for k in meta["keys"]]
+        kb, vb = int(meta["k_page_bytes"]), int(meta["v_page_bytes"])
+        shp_k = (meta["n_layers"], meta["n_kv_heads"], meta["page_size"],
+                 meta["head_dim"])
+        shp_v = (meta["n_layers"], meta["n_kv_heads"], meta["page_size"],
+                 meta["v_head_dim"])
+    except (KeyError, ValueError, TypeError) as e:
+        raise TransportError(f"malformed header: {e}") from None
+    payload = body[hstart + hlen:]
+    if len(payload) != len(keys) * (kb + vb):
+        raise TransportError(
+            f"payload size {len(payload)} does not match "
+            f"{len(keys)} pages of {kb}+{vb} bytes")
+    pages, off = [], 0
+    for key in keys:
+        try:
+            k_page = np.frombuffer(payload, wdt, count=kb // wdt.itemsize,
+                                   offset=off).reshape(shp_k)
+            off += kb
+            v_page = np.frombuffer(payload, wdt, count=vb // wdt.itemsize,
+                                   offset=off).reshape(shp_v)
+            off += vb
+        except ValueError as e:
+            raise TransportError(f"page payload reshape failed: {e}") \
+                from None
+        pages.append((key, k_page, v_page))
+    return meta, pages
+
+
+def check_pool_shape(meta: dict, *, n_layers: int, n_kv_heads: int,
+                     page_size: int, head_dim: int, v_head_dim: int):
+    """Importer-side compatibility gate: the blob's pages must be shaped
+    for THIS pool (storage width may differ — the scatter casts — but
+    geometry may not).  Raises :class:`TransportError` listing the
+    mismatches."""
+    want = {"n_layers": n_layers, "n_kv_heads": n_kv_heads,
+            "page_size": page_size, "head_dim": head_dim,
+            "v_head_dim": v_head_dim}
+    bad = [f"{k}: blob {meta.get(k)!r} != pool {v!r}"
+           for k, v in want.items() if meta.get(k) != v]
+    if bad:
+        raise TransportError(
+            "incompatible page set for this pool — " + "; ".join(bad))

@@ -1,0 +1,169 @@
+"""Host-RAM KV page store: the spill tier between the device page pool
+and the replica fleet.
+
+The device pool (kv.PagedKVCache + engine.PageAllocator) treats a prefix
+page evicted under pressure as LOST — the next request that would have
+hit it recomputes the prefill (``prefix_evictions`` is exactly that
+future-miss trace).  The reference's whole identity is making models fit
+hardware they shouldn't (low-bit weights, FlashMoE host-RAM experts);
+this module applies the same move to paged KV: an evicted prefix page
+(and, at finish, a completed row's decode pages — the multi-turn
+follow-up's prefix) DEMOTES to a byte-budgeted host-RAM LRU instead of
+vanishing, and swaps back through the audited ``hostutil.h2d/d2h``
+boundary on the next prefix hit — a PCIe copy instead of a recompute.
+
+Contracts:
+
+- **byte identity**: entries hold the pool's own storage bytes (e5m2
+  codes for fp8 pools, bf16 halves for bf16 pools) captured with ``d2h``
+  and restored with ``h2d`` + ``PagedKVCache.scatter_pages`` — a
+  swapped-in page is byte-identical to one that never left the pool.
+  (``spill_storage="fp8"`` opts a bf16 pool into e5m2-recoded spill
+  entries — half the host bytes, lossy like the fp8 pool itself.)
+- **budget**: ``hostutil.HostLRU`` — the same evict-to-fit accounting
+  ``offload.ExpertStore`` budgets HBM experts with — bounds resident
+  host bytes; spilling never grows without limit.
+- **transactionality**: the engine's checkpoint/rollback covers the
+  store (``snapshot``/``restore`` are O(entries) bookkeeping copies —
+  blobs are immutable), so a rolled-back tick leaves no spill residue
+  and a rolled-back swap-in puts the consumed entry back.
+
+The store itself is pure host bookkeeping — no device calls, no jitted
+programs (the gathers/scatters live at the engine's epoch boundaries;
+JP106's one-dispatch tick is untouched).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from ipex_llm_tpu.hostutil import HostLRU
+
+__all__ = ["PageStore"]
+
+
+class PageStore:
+    """Byte-budgeted host LRU of spilled KV pages, keyed by the engine's
+    chained prefix hash (a key commits to every token before it, so equal
+    keys imply equal page contents — the property that makes a host
+    entry substitutable for the device page it came from)."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("PageStore needs a positive byte budget")
+        self.lru = HostLRU(budget_bytes)
+        # lifetime counters (swap_ins / lookups are the affinity tier's
+        # freshness signal; see router._affinity_fresh)
+        self.spills = 0          # pages demoted into the store
+        self.swap_ins = 0        # pages promoted back into the pool
+        self.swap_in_lookups = 0  # prefix-chain probes against the store
+        # rolling swap-in latency window for /health (seconds per page)
+        self.swap_in_s: "deque[float]" = deque(maxlen=128)
+        # snapshot memoization: the engine checkpoints the store EVERY
+        # tick, but most ticks never touch it — re-copying the whole LRU
+        # bookkeeping per tick would make tick latency scale with tier
+        # occupancy.  A mutation counter keys a cached snapshot, so an
+        # untouched-store checkpoint is O(1) and only mutating ticks pay
+        # the O(entries) copy.
+        self._mut = 0
+        self._snap: "tuple[int, dict] | None" = None
+
+    # -- spill / swap-in ----------------------------------------------------
+
+    @staticmethod
+    def _nbytes(k_page: np.ndarray, v_page: np.ndarray) -> int:
+        return k_page.nbytes + v_page.nbytes
+
+    def spill(self, key: bytes, k_page: np.ndarray, v_page: np.ndarray):
+        """Demote one page's host copy ([L, Hkv, page, D] k and v, pool
+        storage dtype) into the LRU under the byte budget."""
+        self._mut += 1
+        self.spills += 1
+        self.lru.put(key, (k_page, v_page), self._nbytes(k_page, v_page))
+
+    def take(self, key: bytes):
+        """Consume the entry for ``key`` (None = miss), counting the
+        lookup; the caller scatters it back into the pool and records the
+        latency via ``record_swap_in`` — or hands it back via ``untake``
+        if the promotion could not complete (dry pool, rollback)."""
+        self._mut += 1
+        self.swap_in_lookups += 1
+        if key not in self.lru:
+            return None
+        return self.lru.pop(key)
+
+    def untake(self, key: bytes, entry):
+        """Return a consumed entry unchanged (failed promotion)."""
+        self._mut += 1
+        k_page, v_page = entry
+        self.lru.put(key, entry, self._nbytes(k_page, v_page))
+
+    def record_swap_in(self, seconds: float):
+        self._mut += 1
+        self.swap_ins += 1
+        self.swap_in_s.append(seconds)
+
+    def peek(self, key: bytes):
+        """Non-consuming, non-counting read (the export path serves
+        spilled pages without disturbing swap-in economics)."""
+        if key not in self.lru:
+            return None
+        self._mut += 1          # the LRU hit counter still advances
+        return self.lru.get(key, touch=False)
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /health kv-block spill counters (flat numeric keys so the
+        replica's /metrics exposition and the router's fleet aggregation
+        pick them up unchanged).  Called from the HTTP thread while the
+        engine thread mutates the store, so the latency window is copied
+        with ``list()`` — one GIL-atomic C-level copy, the same
+        mutation-during-iteration guard api_server uses for the metrics
+        dict — before any Python-level iteration."""
+        vals = list(self.swap_in_s)
+        win = np.asarray(vals, np.float64) if vals else None
+        return {
+            "spill_enabled": True,
+            "spill_budget_bytes": self.lru.budget,
+            "spill_bytes": self.lru.used,
+            "spill_pages": len(self.lru),
+            "spills": self.spills,
+            "spill_lru_evictions": self.lru.evictions,
+            "swap_ins": self.swap_ins,
+            "swap_in_lookups": self.swap_in_lookups,
+            "swap_in_hit_rate": round(
+                self.swap_ins / self.swap_in_lookups, 4)
+            if self.swap_in_lookups else 0.0,
+            "swap_in_p95_s": round(float(np.percentile(win, 95)), 5)
+            if win is not None else 0.0,
+        }
+
+    # -- transactionality ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Memoized on the mutation counter: checkpointing an untouched
+        store returns the cached copy (O(1)); only a tick that actually
+        spilled/swapped pays the O(entries) bookkeeping copy."""
+        if self._snap is None or self._snap[0] != self._mut:
+            self._snap = (self._mut, {
+                "lru": self.lru.snapshot(),
+                "spills": self.spills,
+                "swap_ins": self.swap_ins,
+                "swap_in_lookups": self.swap_in_lookups,
+                "swap_in_s": list(self.swap_in_s),
+            })
+        return self._snap[1]
+
+    def restore(self, snap: dict):
+        self.lru.restore(snap["lru"])
+        self.spills = snap["spills"]
+        self.swap_ins = snap["swap_ins"]
+        self.swap_in_lookups = snap["swap_in_lookups"]
+        self.swap_in_s = deque(snap["swap_in_s"],
+                               maxlen=self.swap_in_s.maxlen)
+        # the restored state IS this snapshot: re-key the memo so the
+        # next (unmutated) checkpoint reuses it instead of re-copying
+        self._snap = (self._mut, snap)

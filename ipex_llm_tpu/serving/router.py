@@ -169,7 +169,20 @@ class RouterConfig:
     affinity_free_frac: float = 0.05  # kv pool pressure spill threshold:
     #                                   below this free-page fraction the
     #                                   prefix is likely evicted soon —
-    #                                   spill to least-loaded
+    #                                   spill to least-loaded (unless the
+    #                                   replica's host spill tier covers
+    #                                   the demoted pages; see
+    #                                   _affinity_fresh)
+    # disaggregated prefill/decode (requires per-replica roles): a
+    # streaming request whose prompt text is at least this many chars
+    # hands off — a prefill-role replica computes the pages
+    # (/kv/prefill), a decode-role replica imports them (/kv/import) and
+    # inherits the prompt's affinity, so the stream routed there joins
+    # the fused tick with only the uncovered tail left to prefill.
+    # Every handoff failure is a zero-delivery fallback to the
+    # monolithic path.  0 disables handoff.
+    disagg_prefill_chars: int = 0
+    handoff_timeout_s: float = 120.0  # per-handoff-leg budget
 
 
 class _Replica:
@@ -177,10 +190,17 @@ class _Replica:
     backpressure signals, and the transition log the aggregated /health
     view exposes."""
 
-    def __init__(self, idx: int, backend: "Backend", rc: RouterConfig):
+    def __init__(self, idx: int, backend: "Backend", rc: RouterConfig,
+                 role: str = "any"):
         self.idx = idx
         self.backend = backend
         self.rc = rc
+        # disaggregation role: "any" serves everything (the default —
+        # a monolithic fleet), "prefill" only takes /kv/prefill handoff
+        # legs, "decode" only client streams/completions.  Advisory
+        # under degradation: with no decode-capable replica routable,
+        # a prefill replica still serves rather than shedding.
+        self.role = role
         self.state = HEALTHY
         self.fails = 0             # consecutive poll/request failures
         self.probe_ok = 0          # consecutive successful probes (ejected)
@@ -191,6 +211,13 @@ class _Replica:
         self.inflight = 0          # requests the router routed here, live
         self.shed_until = 0.0      # backpressure memory (429/503 cooloff)
         self.last_health: dict | None = None
+        # handoff capability memory: set when this replica proved unable
+        # to import a page set (no binary transport, or a permanent
+        # shape/format 400) — the handoff orchestration stops paying a
+        # full prefill leg just to throw its blob at a replica that
+        # cannot take it.  Cleared on reinstatement (a restart may fix
+        # shape/version skew).
+        self.handoff_broken = False
         self.transitions: "deque[dict]" = deque(maxlen=64)
         # wedge detection: the last distinct `ticks` value seen in a
         # healthy poll and when it changed (per replica_id incarnation)
@@ -270,6 +297,7 @@ class _Replica:
             if self.probe_ok >= self.rc.reinstate_after:
                 self.fails = 0
                 self.backoff_s = self.rc.probe_backoff_s
+                self.handoff_broken = False   # a restart may have fixed it
                 self._move(HEALTHY, "reinstated")
                 return
             self._move(EJECTED, "probe_ok")   # more successes required
@@ -285,6 +313,7 @@ class _Replica:
         out = {
             "idx": self.idx,
             "target": self.backend.target,
+            "role": self.role,
             "state": self.state,
             "routable": self.routable(now),
             "inflight": self.inflight,
@@ -341,6 +370,16 @@ class Backend:
             self.injector.hit(site, (self.target,))
         except ReplicaConnectRefused as e:
             raise BackendError(f"injected: {e}", stage="connect")
+
+    async def send_bytes(self, path: str, data: bytes,
+                         timeout: float) -> tuple[int, dict, bytes]:
+        """Binary POST (the /kv/import handoff leg).  Backends that
+        don't speak it surface an "unsupported"-stage BackendError: the
+        handoff orchestration treats that as a capability gap (no
+        health strike — the replica is healthy, just not
+        binary-capable) and falls back to the monolithic path."""
+        raise BackendError(f"{type(self).__name__} does not support "
+                           "binary transport", stage="unsupported")
 
     async def drain(self, timeout: float = 30.0) -> bool:
         return False
@@ -425,6 +464,23 @@ class HTTPBackend(Backend):
         try:
             async with sess.post(
                 f"{self.base_url}{path}", json=body,
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                payload = await resp.read()
+                return resp.status, dict(resp.headers), payload
+        except asyncio.TimeoutError:
+            raise BackendError("response timed out", stage="stall")
+        except (aiohttp.ClientError, OSError) as e:
+            raise BackendError(f"{type(e).__name__}: {e}", stage="connect")
+
+    async def send_bytes(self, path: str, data: bytes,
+                         timeout: float) -> tuple[int, dict, bytes]:
+        self._fault("replica-connect")
+        sess = await self._sess()
+        try:
+            async with sess.post(
+                f"{self.base_url}{path}", data=data,
+                headers={"Content-Type": "application/octet-stream"},
                 timeout=aiohttp.ClientTimeout(total=timeout),
             ) as resp:
                 payload = await resp.read()
@@ -685,6 +741,10 @@ _FLEET_SUMMABLE = frozenset({
     "draft_proposed", "draft_accepted", "queue_depth",
     "kv_pages_in_use", "kv_pages_total", "kv_pool_bytes",
     "kv_prefix_evictions", "kv_alloc_fail_clamps",
+    # spill tier + transport (the kv_ prefix is the replica's kv_stats
+    # exposition; pages_imported/exported live in engine.metrics)
+    "kv_spill_pages", "kv_spill_bytes", "kv_spills", "kv_swap_ins",
+    "kv_swap_in_lookups", "kv_pages_imported", "kv_pages_exported",
 })
 
 
@@ -694,12 +754,23 @@ class Router:
     backpressure propagation and prefix-affinity routing.  See the module
     docstring for the four robustness contracts."""
 
-    def __init__(self, backends: list, rc: RouterConfig | None = None):
+    def __init__(self, backends: list, rc: RouterConfig | None = None,
+                 roles: list[str] | None = None):
         if not backends:
             raise ValueError("router needs at least one backend")
         self.rc = rc or RouterConfig()
-        self.replicas = [_Replica(i, b, self.rc)
-                         for i, b in enumerate(backends)]
+        if roles is None:
+            roles = ["any"] * len(backends)
+        if len(roles) != len(backends):
+            raise ValueError(
+                f"{len(roles)} roles for {len(backends)} backends")
+        bad = [r for r in roles if r not in ("any", "prefill", "decode")]
+        if bad:
+            raise ValueError(f"unknown replica roles {bad!r}: each must "
+                             "be 'any', 'prefill', or 'decode'")
+        self.replicas = [_Replica(i, b, self.rc, role=role)
+                         for i, (b, role) in enumerate(zip(backends,
+                                                           roles))]
         self.router_id = uuid.uuid4().hex
         self._inflight = 0
         self._affinity: "OrderedDict[str, tuple[int, int]]" = OrderedDict()
@@ -716,6 +787,12 @@ class Router:
             "probes": 0,
             "ejections": 0,
             "reinstated": 0,
+            # disaggregated prefill/decode handoffs: completed page-set
+            # moves, zero-delivery fallbacks to the monolithic path, and
+            # the wire bytes shipped (the e5m2-halving story's meter)
+            "handoffs": 0,
+            "handoff_failures": 0,
+            "handoff_bytes": 0,
         }
 
     # -- lifecycle -----------------------------------------------------------
@@ -834,40 +911,77 @@ class Router:
 
     # -- routing -------------------------------------------------------------
 
-    def _prefix_key(self, path: str, body: dict) -> str | None:
+    def _prompt_text(self, path: str, body: dict) -> str:
+        """The request's prompt as one string — the affinity key's
+        source and the disaggregation threshold's yardstick."""
         if "chat/completions" in path:
-            src = json.dumps(body.get("messages", []), sort_keys=True)
-        elif "completions" in path:
+            return json.dumps(body.get("messages", []), sort_keys=True)
+        if "completions" in path:
             p = body.get("prompt", "")
-            src = p[0] if isinstance(p, list) and p else p
-        else:
-            src = body.get("inputs", "")
-        src = str(src)[: self.rc.affinity_prefix_chars]
+            return str(p[0] if isinstance(p, list) and p else p)
+        return str(body.get("inputs", ""))
+
+    def _prefix_key(self, path: str, body: dict) -> str | None:
+        src = self._prompt_text(path, body)[
+            : self.rc.affinity_prefix_chars]
         if not src:
             return None
         return hashlib.sha1(src.encode()).hexdigest()
 
+    @staticmethod
+    def _spill_covers(kv: dict) -> bool:
+        """Does the replica's host spill tier plausibly cover pages the
+        device pool let go?  With a spill tier, ``prefix_evictions``
+        advancing means DEMOTION, not loss — the page swaps back on the
+        next hit — so affinity should hold.  True only when the tier is
+        enabled, actually holds pages (or has proven swap-ins), and is
+        retaining what it is given: a tier whose own byte budget is
+        dropping most of its demoted pages (``spill_lru_evictions``
+        running at the spill rate) really IS losing prefixes, and
+        affinity should degrade exactly as it would untiered.  (The
+        swap-in hit RATE is deliberately not the signal here: every
+        novel-prompt admission probes the store and counts a miss, so
+        mixed traffic dilutes it without a single page being lost.)"""
+        if not kv.get("spill_enabled"):
+            return False
+        if kv.get("spill_pages", 0) <= 0 and kv.get("swap_ins", 0) == 0:
+            return False
+        spills = kv.get("spills", 0)
+        lost = kv.get("spill_lru_evictions", 0)
+        return not (spills >= 8 and lost > spills * 0.5)
+
     def _affinity_fresh(self, rep: _Replica, evict_mark: int) -> bool:
-        """Is the remembered prefix likely still resident?  The replica's
-        /health kv block is the signal: prefix evictions since the mark
-        mean the cached pages may be gone; a nearly-dry pool means they
-        soon will be.  Either way affinity degrades to least-loaded."""
+        """Is the remembered prefix likely still SERVABLE there?  The
+        replica's /health kv block is the signal: prefix evictions since
+        the mark mean the cached pages may be gone, a nearly-dry pool
+        means they soon will be — UNLESS the replica runs a spill tier
+        whose /health block shows it holding up, in which case an
+        eviction is a demotion the next hit swaps back.  Only a genuine
+        loss degrades affinity to least-loaded."""
         h = rep.last_health
         if not h or "kv" not in h:
             return True   # no signal yet: assume resident
         kv = h["kv"]
-        if kv.get("prefix_evictions", 0) > evict_mark:
+        if (kv.get("prefix_evictions", 0) > evict_mark
+                and not self._spill_covers(kv)):
             return False
         total = kv.get("pages_total", 0)
-        if total and kv.get("pages_free", 0) < total * \
-                self.rc.affinity_free_frac:
+        if (total and kv.get("pages_free", 0) < total
+                * self.rc.affinity_free_frac
+                and not self._spill_covers(kv)):
             return False
         return True
 
-    def _pick(self, key: str | None, exclude: set[int],
-              now: float) -> _Replica | None:
+    def _pick(self, key: str | None, exclude: set[int], now: float,
+              role: str = "decode") -> _Replica | None:
         cands = [r for r in self.replicas
                  if r.routable(now) and r.idx not in exclude]
+        # role preference (disaggregated fleets): client traffic goes to
+        # decode-capable replicas, handoff prefills to prefill-capable
+        # ones — advisory, so a degraded fleet serves from whatever is
+        # left rather than shedding on principle
+        preferred = [r for r in cands if r.role in (role, "any")]
+        cands = preferred or cands
         if not cands:
             return None
         if key is not None and key in self._affinity:
@@ -1019,6 +1133,116 @@ class Router:
         return {k: v for k, v in headers.items()
                 if k.lower() in ("content-type", "retry-after")}
 
+    # -- disaggregated prefill/decode handoff --------------------------------
+
+    def _disagg_eligible(self, path: str, body: dict) -> bool:
+        return (self.rc.disagg_prefill_chars > 0
+                and len(self._prompt_text(path, body))
+                >= self.rc.disagg_prefill_chars)
+
+    async def _handoff(self, path: str, body: dict, key: str | None,
+                       deadline: float | None):
+        """Disaggregated prefill: compute the prompt's KV pages on a
+        prefill-role replica (/kv/prefill), import them into a
+        decode-role replica (/kv/import), and home the prompt's affinity
+        there — the stream dispatched next lands on the importer and
+        prefills only the uncovered tail.
+
+        EVERY failure here is a zero-delivery failover by construction:
+        nothing has reached the client yet, so a mid-handoff death just
+        notes the health strike (the state machine ejects a dying
+        replica exactly as it would for a failed request), counts
+        ``handoff_failures``, and the caller falls back to the
+        monolithic path — no lost, hung, or duplicated stream."""
+        now = time.monotonic()
+        # the prefill leg requires an EXPLICIT prefill-role replica —
+        # _pick's advisory fallback would otherwise "hand off" between
+        # two ordinary replicas, silently doubling prefill compute on a
+        # monolithic fleet with disagg_prefill_chars set
+        pre_cands = [r for r in self.replicas
+                     if r.role == "prefill" and r.routable(now)]
+        pre = (min(pre_cands, key=lambda r: (r.load(), r.idx))
+               if pre_cands else None)
+        # the decode replica is picked LEAST-LOADED, deliberately
+        # ignoring affinity: transportable pages are what make the
+        # affinity pin obsolete — the prefix moves to wherever capacity
+        # is (the prefill replica's own prefix cache makes repeat
+        # exports nearly free), so a shared hot prefix spreads across
+        # decode replicas instead of hot-spotting its first home.
+        # Import-incapable replicas (handoff_broken) are excluded UP
+        # FRONT: discovering that only after paying the prefill leg
+        # would tax every eligible request for a blob nobody can take.
+        skip = {pre.idx} if pre is not None else set()
+        skip |= {r.idx for r in self.replicas if r.handoff_broken}
+        dec = self._pick(None, skip, now, role="decode")
+        if pre is None or dec is None or pre.idx == dec.idx:
+            return     # no split fleet to hand off across
+        if key is not None and key in self._affinity:
+            idx, mark = self._affinity[key]
+            if idx == dec.idx and self._affinity_fresh(dec, mark):
+                # the least-loaded decode pick ALREADY holds this
+                # prefix (a prior handoff or admission homed it there):
+                # re-shipping the blob would import zero pages — skip
+                # the legs and let the dispatch loop route by affinity
+                self._affinity.move_to_end(key)
+                return
+        budget = self.rc.handoff_timeout_s
+        if deadline is not None:
+            budget = min(budget, max(deadline - now, 0.001))
+        pre.inflight += 1
+        try:
+            pre.backend._fault("replica-handoff")
+            status, headers, blob = await pre.backend.send_json(
+                "/kv/prefill", self._fwd_body(body, deadline), budget)
+        except (BackendError, ReplicaFault) as e:
+            # ReplicaFault covers injected shapes _fault does not
+            # translate (e.g. a scripted stream-hang at this site): any
+            # of them is still just a zero-delivery handoff death
+            self._note_transport_failure(
+                pre, f"handoff_{getattr(e, 'stage', 'fault')}")
+            self.counters["handoff_failures"] += 1
+            return
+        finally:
+            pre.inflight -= 1
+        if status != 200:
+            # replica-authored refusal (shed / nothing-to-export): no
+            # health strike, just no handoff this time
+            if status in (429, 503):
+                pre.shed_until = time.monotonic() + \
+                    self._replica_retry_after(headers)
+            self.counters["handoff_failures"] += 1
+            return
+        dec.inflight += 1
+        try:
+            dec.backend._fault("replica-handoff")
+            s2, _, _ = await dec.backend.send_bytes("/kv/import", blob,
+                                                    budget)
+        except (BackendError, ReplicaFault) as e:
+            if getattr(e, "stage", None) == "unsupported":
+                # a capability gap is not a death: no health strike,
+                # but remember it so later handoffs skip this replica
+                dec.handoff_broken = True
+            else:
+                self._note_transport_failure(
+                    dec, f"handoff_{getattr(e, 'stage', 'fault')}")
+            self.counters["handoff_failures"] += 1
+            return
+        finally:
+            dec.inflight -= 1
+        if s2 != 200:
+            if s2 == 400:
+                # the importer REJECTED the page set (shape/format skew
+                # — permanent until the replica is rebuilt): stop
+                # re-shipping blobs it will keep refusing
+                dec.handoff_broken = True
+            self.counters["handoff_failures"] += 1
+            return
+        self.counters["handoffs"] += 1
+        self.counters["handoff_bytes"] += len(blob)
+        # home the prompt on the importer: the dispatch loop's affinity
+        # pick routes the stream (and future same-prefix requests) there
+        self._record_affinity(key, dec)
+
     async def dispatch_json(self, path: str, body: dict) -> RouterResponse:
         """Non-streaming request through the fleet.  Nothing reaches the
         client until a replica's full response is in hand, so EVERY
@@ -1106,6 +1330,12 @@ class Router:
         #                     other exit releases it in the finally below
         replay_pending = False
         try:
+            if self._disagg_eligible(path, body):
+                # the handoff is pure pre-work: success homes the
+                # prompt's affinity on the importing decode replica,
+                # any failure falls through to the ordinary loop below
+                # with zero tokens delivered
+                await self._handoff(path, body, key, deadline)
             while True:
                 rep, done = self._next_replica(surface, key, tried,
                                                attempts, deadline)
@@ -1471,6 +1701,18 @@ def main(argv=None):
     ap.add_argument("--request-deadline", type=float, default=0.0,
                     metavar="S", help="default end-to-end deadline "
                     "spanning ALL failover attempts (0 = none)")
+    ap.add_argument("--roles", default=None,
+                    help="comma-separated per-replica roles "
+                         "(any|prefill|decode), one per replica — the "
+                         "disaggregated-fleet shape, e.g. "
+                         "'prefill,decode,decode'")
+    ap.add_argument("--disagg-prefill-chars", type=int, default=0,
+                    metavar="N",
+                    help="disaggregated prefill/decode: streaming "
+                         "prompts of at least N characters hand off — "
+                         "a prefill-role replica computes the KV pages, "
+                         "a decode-role replica imports them and serves "
+                         "the stream (0 = off; requires --roles)")
     args = ap.parse_args(argv)
 
     rc = RouterConfig(
@@ -1484,6 +1726,7 @@ def main(argv=None):
         first_event_timeout_s=args.first_event_timeout,
         max_inflight=args.max_inflight,
         request_deadline_s=args.request_deadline,
+        disagg_prefill_chars=args.disagg_prefill_chars,
     )
     if args.replicas.isdigit():
         if not args.model:
@@ -1493,7 +1736,9 @@ def main(argv=None):
     else:
         backends = [HTTPBackend(u.strip())
                     for u in args.replicas.split(",") if u.strip()]
-    router = Router(backends, rc)
+    roles = ([r.strip() for r in args.roles.split(",")]
+             if args.roles else None)
+    router = Router(backends, rc, roles=roles)
 
     async def on_startup(app):
         await router.start()   # starts any un-started in-process backend

@@ -260,7 +260,10 @@ def test_fail_all_only_when_bisection_fails(cfg_params):
 def test_injector_validates_sites():
     with pytest.raises(ValueError):
         FaultInjector().inject("not-a-site", TransientFault)
-    assert len(FAULT_SITES) == 5
+    # 5 engine-step sites + the PR 11 spill/transport sites
+    assert len(FAULT_SITES) == 9
+    for site in ("spill-store", "swap-in", "kv-export", "kv-import"):
+        assert site in FAULT_SITES
 
 
 def test_is_transient_classification():

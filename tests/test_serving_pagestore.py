@@ -1,0 +1,388 @@
+"""Transportable KV pages — host-RAM spill tier + export/import format.
+
+The contracts under test (the PR 11 page-store subsystem):
+
+- **HostLRU** (satellite: the byte-budgeted LRU hoisted out of
+  ``offload.ExpertStore``): evict-to-fit under the byte budget, LRU
+  order, hit/miss/eviction counters, bookkeeping-only snapshot/restore;
+- **spill → swap-in byte identity**: a prefix page evicted to the host
+  store and swapped back on the next prefix hit is BYTE-identical to a
+  page that never left the pool — for bf16 AND fp8 pools — and the
+  tiered engine's output stays bit-identical to an untiered engine's;
+- **cold-row spill**: a cleanly-finished row's decode pages (the
+  multi-turn follow-up's prefix) demote at finish and serve the
+  follow-up prompt via swap-in;
+- **budget enforcement**: resident spill bytes never exceed the
+  configured budget (oldest pages fall off);
+- **transactionality**: a tick that spilled or swapped in and then
+  rolled back (transient fault, bisection probe) leaves the store
+  residue-free — the retried tick is bit-identical and counters never
+  double-count;
+- **transport round-trip**: export → import into a fresh engine moves
+  the pages byte-exactly (native fp8 codes; wire="bf16" for bf16
+  pools), seeds the importer's prefix cache, and REJECTS corrupted /
+  truncated / wrong-magic / wrong-version / wrong-shape blobs without
+  scattering a byte.
+
+The disaggregated handoff fault (mid-handoff death → zero-delivery
+failover) is exercised at the router tier in test_serving_router.py.
+"""
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.hostutil import HostLRU, d2h
+from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
+                                         ServingEngine, _chain_hashes,
+                                         stream_tokens)
+from ipex_llm_tpu.serving.faults import FaultInjector, TransientFault
+from ipex_llm_tpu.serving.kv_transport import (TransportError, pack_pages,
+                                               unpack_pages)
+from ipex_llm_tpu.serving.pagestore import PageStore
+from tests.test_decoder import rand_params, tiny_cfg
+
+RNG = np.random.default_rng(17)
+
+# a deliberately tight pool: 7 usable pages, so a third 3-page request
+# must evict the first request's cached prefix pages
+EC = dict(max_rows=2, max_seq_len=256, page_size=32, prefill_bucket=32,
+          pool_pages=8, retry_backoff_s=0.001)
+SPILL = 1 << 22     # 4 MiB host budget: plenty for the tiny model
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=131, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+def _drive(eng, req, ticks=3000):
+    """Synchronous engine drive (no thread): deterministic tick-by-tick."""
+    eng.submit(req)
+    for _ in range(ticks):
+        eng._tick()
+        if req.finish_reason is not None:
+            return list(stream_tokens(req, timeout=5))
+    raise AssertionError("request never finished")
+
+
+def _page_bytes(eng, pid) -> tuple[bytes, bytes]:
+    k, v = eng.cache.gather_pages(np.asarray([pid], np.int32))
+    return d2h(k).tobytes(), d2h(v).tobytes()
+
+
+# -- HostLRU (the hoisted ExpertStore/PageStore budget helper) ---------------
+
+def test_hostlru_budget_lru_order_and_counters():
+    lru = HostLRU(100)
+    lru.put("a", 1, 40)
+    lru.put("b", 2, 40)
+    assert lru.get("a") == 1            # touch: a is now most-recent
+    lru.put("c", 3, 40)                 # evicts b (LRU), not a
+    assert lru.used == 80 and len(lru) == 2
+    assert "b" not in lru and "a" in lru and "c" in lru
+    assert lru.evictions == 1
+    assert lru.get("b") is None
+    assert (lru.hits, lru.misses) == (1, 1)
+    # an entry bigger than the whole budget degrades to a 1-entry cache
+    # (the historical ExpertStore behaviour) instead of a dead one
+    lru.put("big", 4, 500)
+    assert len(lru) == 1 and lru.get("big") == 4
+
+
+def test_hostlru_snapshot_restore_and_pop():
+    lru = HostLRU(100)
+    lru.put("a", "x", 30)
+    snap = lru.snapshot()
+    lru.put("b", "y", 30)
+    assert lru.pop("a") == "x" and lru.used == 30
+    lru.restore(snap)
+    assert "a" in lru and "b" not in lru and lru.used == 30
+    assert lru.pop("missing") is None
+
+
+def test_expert_store_rides_hostlru():
+    """The satellite's point: ONE budget/eviction implementation."""
+    from ipex_llm_tpu.offload import ExpertStore
+
+    store = ExpertStore({}, 1024)
+    assert isinstance(store._cache, HostLRU)
+    assert store.hits == 0 and store.misses == 0
+
+
+# -- PageStore ---------------------------------------------------------------
+
+def test_pagestore_spill_take_untake_stats():
+    st = PageStore(10_000)
+    k = np.zeros((2, 2, 4, 3), np.float32)
+    v = np.ones((2, 2, 4, 3), np.float32)
+    st.spill(b"k1", k, v)
+    assert st.stats()["spill_pages"] == 1
+    assert st.stats()["spill_bytes"] == k.nbytes + v.nbytes
+    assert st.take(b"nope") is None           # miss counts a lookup
+    entry = st.take(b"k1")
+    assert entry is not None and st.stats()["spill_pages"] == 0
+    st.untake(b"k1", entry)                   # failed promotion: back
+    assert st.stats()["spill_pages"] == 1
+    entry = st.take(b"k1")
+    st.record_swap_in(0.01)
+    s = st.stats()
+    assert s["swap_ins"] == 1 and s["swap_in_lookups"] == 3
+    assert s["swap_in_hit_rate"] == round(1 / 3, 4)
+    assert s["swap_in_p95_s"] > 0
+    with pytest.raises(ValueError):
+        PageStore(0)
+
+
+def test_pagestore_budget_drops_oldest():
+    k = np.zeros((4, 8), np.float32)          # 128 bytes each
+    st = PageStore(2 * 2 * k.nbytes)          # room for exactly 2 pages
+    for i in range(3):
+        st.spill(bytes([i]), k.copy(), k.copy())
+    s = st.stats()
+    assert s["spill_pages"] == 2 and s["spill_bytes"] <= st.lru.budget
+    assert st.peek(bytes([0])) is None        # oldest fell off
+    assert st.peek(bytes([2])) is not None
+
+
+# -- spill → swap-in byte identity (bf16 and fp8 pools) ----------------------
+
+@pytest.mark.parametrize("storage", ["bf16", "fp8"])
+def test_spill_swap_in_byte_identity(cfg_params, storage):
+    """A page that round-trips through the host tier must be
+    byte-identical to one that never left the pool, and the tiered
+    engine's streams bit-identical to an untiered engine's."""
+    cfg, params = cfg_params
+    ec = dict(EC, kv_storage=storage)
+    prompt = list(RNG.integers(1, 131, 70).astype(int))
+    others = [list(RNG.integers(1, 131, 70).astype(int)) for _ in range(4)]
+
+    ref_eng = ServingEngine(cfg, params, EngineConfig(**ec))
+    ref = _drive(ref_eng, Request(prompt_ids=prompt, max_new_tokens=8))
+
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(kv_spill_bytes=SPILL, **ec))
+    out = _drive(eng, Request(prompt_ids=prompt, max_new_tokens=8))
+    assert out == ref
+    keys = _chain_hashes(np.asarray(prompt, np.int32), ec["page_size"])
+    before = {k: _page_bytes(eng, eng.alloc.prefix[k])
+              for k in keys[:2] if k in eng.alloc.prefix}
+    assert before, "prompt registered no prefix pages — test is vacuous"
+
+    for o in others:        # pool pressure: evict (now: demote) them
+        _drive(eng, Request(prompt_ids=o, max_new_tokens=8))
+    stats = eng.pagestore.stats()
+    assert stats["spill_pages"] > 0 and stats["spills"] > 0
+    assert eng.alloc.prefix_evictions > 0
+    assert all(k not in eng.alloc.prefix for k in before)
+
+    out2 = _drive(eng, Request(prompt_ids=prompt, max_new_tokens=8))
+    assert out2 == ref                       # swapped-in prefix: same stream
+    stats = eng.pagestore.stats()
+    assert stats["swap_ins"] >= len(before)
+    assert stats["swap_in_p95_s"] > 0.0
+    for k, (kb, vb) in before.items():
+        pid = eng.alloc.prefix.get(k)
+        assert pid is not None, "swap-in did not re-register the prefix"
+        k_now, v_now = _page_bytes(eng, pid)
+        assert k_now == kb and v_now == vb   # BYTE identity
+
+    kv = eng.kv_stats()                      # the /health spill block
+    for key in ("spill_enabled", "spill_pages", "spill_bytes", "swap_ins",
+                "swap_in_hit_rate", "swap_in_p95_s", "spill_budget_bytes"):
+        assert key in kv, key
+    assert kv["spill_enabled"] is True
+    assert ref_eng.kv_stats()["spill_enabled"] is False
+
+
+def test_cold_row_spill_serves_multiturn_followup(cfg_params):
+    """A finished row's decode pages demote at finish; the multi-turn
+    follow-up (prompt + generated text + new user turn) swap-ins them
+    instead of re-prefilling the whole history."""
+    cfg, params = cfg_params
+    # 60-token prompt + 40 outputs: pages 0..1 are prompt-registered,
+    # page 2 (slots 64..95, fully inside prompt+outputs[:-1]) is the
+    # cold decode page that must spill at finish
+    prompt = list(RNG.integers(1, 131, 60).astype(int))
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(kv_spill_bytes=SPILL, **EC))
+    r = Request(prompt_ids=prompt, max_new_tokens=40)
+    out = _drive(eng, r)
+    assert len(out) == 40
+    st = eng.pagestore.stats()
+    assert st["spills"] >= 1, "no cold-row spill at finish"
+
+    follow = prompt + out + list(RNG.integers(1, 131, 8).astype(int))
+    ref_eng = ServingEngine(cfg, params, EngineConfig(**EC))
+    _drive(ref_eng, Request(prompt_ids=list(prompt),
+                            max_new_tokens=40))
+    ref = _drive(ref_eng, Request(prompt_ids=list(follow),
+                                  max_new_tokens=8))
+    out2 = _drive(eng, Request(prompt_ids=list(follow), max_new_tokens=8))
+    assert out2 == ref
+    assert eng.pagestore.stats()["swap_ins"] >= 1
+
+
+# -- transactionality --------------------------------------------------------
+
+def test_rollback_leaves_store_residue_free(cfg_params):
+    """checkpoint → mutate the store (spill + swap-in consumption) →
+    rollback: the store is bit-for-bit the checkpointed one."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(kv_spill_bytes=SPILL, **EC))
+    prompt = list(RNG.integers(1, 131, 70).astype(int))
+    _drive(eng, Request(prompt_ids=prompt, max_new_tokens=8))
+    key = next(iter(eng.alloc.prefix))
+    pid = eng.alloc.prefix[key]
+
+    st0 = eng.pagestore.stats()
+    snap = eng._checkpoint()
+    eng._spill_pages([(key, pid)])              # a spill the tick will undo
+    taken = eng.pagestore.take(key)
+    assert taken is not None
+    assert eng.pagestore.stats()["spills"] == st0["spills"] + 1
+    eng._rollback(snap)
+    assert eng.pagestore.stats() == st0
+
+
+@pytest.mark.parametrize("site", ["spill-store", "swap-in"])
+def test_injected_fault_retries_bit_identically(cfg_params, site):
+    """A transient fault at a spill-tier site rolls the tick back
+    (residue-free store) and the retry is bit-identical — swap-in
+    counters never double-count."""
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(1, 131, 70).astype(int))
+    others = [list(RNG.integers(1, 131, 70).astype(int)) for _ in range(4)]
+
+    def run(injector):
+        eng = ServingEngine(cfg, params,
+                            EngineConfig(kv_spill_bytes=SPILL, **EC),
+                            fault_injector=injector)
+        outs = [_drive(eng, Request(prompt_ids=p, max_new_tokens=8))
+                for p in [prompt] + others + [prompt]]
+        return eng, outs
+
+    _, ref_outs = run(None)
+    inj = FaultInjector().inject(site, TransientFault, nth=1, times=1)
+    eng, outs = run(inj)
+    assert inj.fired == 1, f"{site} fault never fired"
+    assert outs == ref_outs
+    assert eng.metrics["retries"] >= 1
+    st = eng.pagestore.stats()
+    if site == "swap-in":
+        # the rolled-back take() came back: exactly one counted swap-in
+        # per page despite the retry
+        assert st["swap_ins"] >= 1
+
+
+# -- transport round-trip ----------------------------------------------------
+
+def _export_import_roundtrip(cfg, params, ec, wire):
+    prompt = list(RNG.integers(1, 131, 70).astype(int))
+    src = ServingEngine(cfg, params, EngineConfig(**ec))
+    ref = _drive(src, Request(prompt_ids=prompt, max_new_tokens=8))
+    blob = src.export_prefix(prompt, wire=wire)
+    assert blob is not None
+    keys = _chain_hashes(np.asarray(prompt, np.int32), ec["page_size"])
+    src_pages = {k: _page_bytes(src, src.alloc.prefix[k])
+                 for k in keys[:2]}
+
+    dst = ServingEngine(cfg, params, EngineConfig(**ec))
+    res = dst.import_pages(blob)
+    assert res["imported_pages"] == 2 and res["tokens_covered"] == 64
+    for k, (kb, vb) in src_pages.items():
+        assert _page_bytes(dst, dst.alloc.prefix[k]) == (kb, vb)
+    out = _drive(dst, Request(prompt_ids=prompt, max_new_tokens=8))
+    assert out == ref                 # the imported prefix hit exactly
+    assert dst.metrics["prefix_hits"] == 1
+    assert dst.metrics["kv_pages_imported"] == 2
+    assert src.metrics["kv_pages_exported"] == 2
+    # idempotent re-import: already-cached keys skip
+    res2 = dst.import_pages(blob)
+    assert res2 == {**res2, "imported_pages": 0, "skipped_pages": 2}
+    return blob
+
+
+def test_transport_roundtrip_fp8_native(cfg_params):
+    """fp8 pools ship their e5m2 codes natively: auto wire, byte-exact."""
+    cfg, params = cfg_params
+    _export_import_roundtrip(cfg, params, dict(EC, kv_storage="fp8"),
+                             "auto")
+
+
+def test_transport_roundtrip_bf16_exact_wire(cfg_params):
+    """bf16 pools are byte-exact on the bf16 wire; the default e5m2 wire
+    (half the handoff bytes) still round-trips structurally and is half
+    the payload."""
+    cfg, params = cfg_params
+    blob16 = _export_import_roundtrip(cfg, params, dict(EC), "bf16")
+    src = ServingEngine(cfg, params, EngineConfig(**EC))
+    prompt = list(RNG.integers(1, 131, 70).astype(int))
+    _drive(src, Request(prompt_ids=prompt, max_new_tokens=8))
+    blob8 = src.export_prefix(prompt)          # auto = e5m2 wire
+    meta, pages = unpack_pages(blob8)
+    assert meta["wire"] == "fp8" and len(pages) == 2
+    # payload halves (headers/digest amortize): e5m2 is 1 byte vs 2
+    assert len(blob8) < 0.62 * len(blob16)
+
+
+def test_transport_rejects_malformed_blobs(cfg_params):
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(**EC))
+    prompt = list(RNG.integers(1, 131, 70).astype(int))
+    _drive(eng, Request(prompt_ids=prompt, max_new_tokens=4))
+    blob = eng.export_prefix(prompt)
+    imported0 = eng.metrics.get("kv_pages_imported", 0)
+
+    with pytest.raises(TransportError, match="too short"):
+        unpack_pages(b"IPLT")
+    with pytest.raises(TransportError, match="magic"):
+        unpack_pages(b"X" * len(blob))
+    with pytest.raises(TransportError, match="checksum"):
+        unpack_pages(blob[:-10])                       # truncated
+    with pytest.raises(TransportError, match="checksum"):
+        unpack_pages(blob[:50] + bytes([blob[50] ^ 1]) + blob[51:])
+    # version gate: regenerate the digest so ONLY the version differs
+    import hashlib
+    body = bytearray(blob[:-32])
+    idx = bytes(body).find(b'"version": 1')
+    body[idx:idx + 12] = b'"version": 9'
+    with pytest.raises(TransportError, match="version"):
+        unpack_pages(bytes(body) + hashlib.sha256(bytes(body)).digest())
+    # pool-shape gate: a pool with a different page size must refuse
+    other = ServingEngine(cfg, params, EngineConfig(
+        **dict(EC, page_size=64, pool_pages=6)))
+    with pytest.raises(TransportError, match="incompatible"):
+        other.import_pages(blob)
+    # none of the rejects scattered anything
+    assert eng.metrics.get("kv_pages_imported", 0) == imported0
+    assert other.metrics.get("kv_pages_imported", 0) == 0
+
+
+def test_pack_unpack_preserves_bytes_and_keys():
+    shape = dict(n_layers=2, n_kv_heads=2, page_size=4, head_dim=3,
+                 v_head_dim=5)
+    import jax.numpy as jnp
+    kd = np.dtype(jnp.float8_e5m2)
+    k = RNG.standard_normal((2, 2, 4, 3)).astype(kd)
+    v = RNG.standard_normal((2, 2, 4, 5)).astype(kd)
+    blob = pack_pages(shape, [(b"\x01\x02", k, v)], wire="fp8")
+    meta, pages = unpack_pages(blob)
+    (key, k2, v2), = pages
+    assert key == b"\x01\x02"
+    assert k2.tobytes() == k.tobytes() and v2.tobytes() == v.tobytes()
+    assert meta["page_size"] == 4 and meta["wire"] == "fp8"
+
+
+def test_export_nothing_cached_returns_none(cfg_params):
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(**EC))
+    assert eng.export_prefix(list(range(1, 80))) is None
+    # sub-page prompts have no full shareable page either
+    eng2 = ServingEngine(cfg, params, EngineConfig(**EC))
+    _drive(eng2, Request(prompt_ids=list(range(1, 20)),
+                         max_new_tokens=2))
+    assert eng2.export_prefix(list(range(1, 20))) is None

@@ -735,6 +735,166 @@ def test_slow_loris_replica_fault_injected(cfg_params):
 
 
 # ---------------------------------------------------------------------------
+# disaggregated prefill/decode (the PR 11 transportable-KV handoff)
+
+
+FP8_EC = dict(EC, kv_storage="fp8")
+
+
+def _fp8_factory(cfg, params):
+    def make():
+        return ServingEngine(cfg, params, EngineConfig(**FP8_EC)).start()
+    return make
+
+
+def _reference_text_fp8(cfg, params, prompt_ids, n_out=8) -> str:
+    eng = ServingEngine(cfg, params, EngineConfig(**FP8_EC))
+    r = Request(prompt_ids=list(prompt_ids), max_new_tokens=n_out)
+    eng.submit(r)
+    for _ in range(2000):
+        eng._tick()
+        if r.finish_reason is not None:
+            break
+    assert r.finish_reason is not None
+    return _Tok().decode(list(stream_tokens(r, timeout=5)))
+
+
+_DISAGG_PROMPT = " ".join(str((7 * i) % 131 or 1) for i in range(48))
+
+
+def test_role_preference_routes_traffic_to_decode_replicas():
+    """In a role-split fleet, client traffic prefers decode-capable
+    replicas and only degrades onto a prefill-role replica when nothing
+    else is routable — roles are advisory, never a shed."""
+    async def scenario():
+        b_pre, b_dec = FakeBackend("pre"), FakeBackend("dec",
+                                                       queue_depth=5)
+        router = Router([b_pre, b_dec], _rc(),
+                        roles=["prefill", "decode"])
+        await router.poll_once()
+        # decode replica wins despite its heavier load
+        res = await router.dispatch_json(
+            "/v1/completions", {"prompt": "x y z", "max_tokens": 4})
+        assert json.loads(res.payload)["served_by"] == "dec"
+        # decode replica gone: the prefill replica serves rather than
+        # shedding on principle
+        router.replicas[1].eject(time.monotonic(), "test")
+        res = await router.dispatch_json(
+            "/v1/completions", {"prompt": "a b c", "max_tokens": 4})
+        assert json.loads(res.payload)["served_by"] == "pre"
+        # bad role specs fail loudly
+        with pytest.raises(ValueError, match="roles"):
+            Router([FakeBackend("x")], _rc(), roles=[])
+        with pytest.raises(ValueError, match="unknown replica roles"):
+            Router([FakeBackend("x")], _rc(), roles=["chef"])
+
+    asyncio.run(scenario())
+
+
+def test_disagg_handoff_e2e_bit_identity(cfg_params):
+    """The disaggregated path end to end over REAL replicas (fp8 pools:
+    e5m2 wire codes ship natively, so the handoff is lossless): the
+    prefill replica computes + exports the prompt's pages, the decode
+    replica imports them, inherits the affinity, and streams a
+    bit-identical continuation having prefilled only the uncovered
+    tail."""
+    cfg, params = cfg_params
+    ids = [int(x) for x in _DISAGG_PROMPT.split()]
+    ref = _reference_text_fp8(cfg, params, ids)
+
+    async def scenario():
+        b_pre = InProcessBackend(_fp8_factory(cfg, params), _Tok(), "tiny")
+        b_dec = InProcessBackend(_fp8_factory(cfg, params), _Tok(), "tiny")
+        await b_pre.start()
+        await b_dec.start()
+        router = Router([b_pre, b_dec],
+                        _rc(disagg_prefill_chars=16, stall_timeout_s=30.0),
+                        roles=["prefill", "decode"])
+        try:
+            await router.poll_once()
+            res = await router.dispatch_stream(
+                "/v1/completions",
+                {"prompt": _DISAGG_PROMPT, "max_tokens": 8,
+                 "temperature": 0.0, "stream": True})
+            assert isinstance(res, RouterStream)
+            pieces, err, done = await _consume(res)
+            assert err is None and done
+            assert "".join(pieces).strip() == ref
+            assert router.counters["handoffs"] == 1
+            assert router.counters["handoff_failures"] == 0
+            assert router.counters["handoff_bytes"] > 0
+            # the pages really moved: exported by the prefill engine,
+            # imported by the decode engine, and the stream's admission
+            # prefix-hit them (only the tail prefilled there)
+            assert b_pre.engine.metrics.get("kv_pages_exported", 0) == 1
+            assert b_dec.engine.metrics.get("kv_pages_imported", 0) == 1
+            assert b_dec.engine.metrics.get("prefix_hits", 0) == 1
+            # the role split held: the prefill replica never served the
+            # client stream (its only request was the handoff leg)
+            assert b_dec.engine.metrics["requests"] == 1
+            # aggregated /health shows the roles
+            view = router.health_view()
+            assert [r["role"] for r in view["replicas"]] == \
+                ["prefill", "decode"]
+        finally:
+            await router.close()
+
+    asyncio.run(scenario())
+
+
+def test_disagg_midhandoff_death_is_zero_delivery_failover(cfg_params):
+    """A replica dying MID-HANDOFF (either leg) is invisible to the
+    client: zero tokens were delivered, so the router notes the health
+    strike, counts handoff_failures, and serves the stream through the
+    monolithic path — bit-identical text, no error event, no hang, no
+    duplicate."""
+    cfg, params = cfg_params
+    ids = [int(x) for x in _DISAGG_PROMPT.split()]
+    ref = _reference_text_fp8(cfg, params, ids)
+
+    async def scenario():
+        for victim in ("prefill", "decode"):
+            inj = FaultInjector().inject("replica-handoff",
+                                         ReplicaConnectRefused, times=1)
+            b_pre = InProcessBackend(
+                _fp8_factory(cfg, params), _Tok(), "tiny",
+                injector=inj if victim == "prefill" else None)
+            b_dec = InProcessBackend(
+                _fp8_factory(cfg, params), _Tok(), "tiny",
+                injector=inj if victim == "decode" else None)
+            await b_pre.start()
+            await b_dec.start()
+            router = Router([b_pre, b_dec],
+                            _rc(disagg_prefill_chars=16, eject_after=3,
+                                stall_timeout_s=30.0),
+                            roles=["prefill", "decode"])
+            try:
+                await router.poll_once()
+                res = await router.dispatch_stream(
+                    "/v1/completions",
+                    {"prompt": _DISAGG_PROMPT, "max_tokens": 8,
+                     "temperature": 0.0, "stream": True})
+                assert isinstance(res, RouterStream), res
+                pieces, err, done = await _consume(res)
+                assert err is None and done, (victim, err)
+                assert "".join(pieces).strip() == ref
+                assert inj.fired == 1
+                assert router.counters["handoffs"] == 0
+                assert router.counters["handoff_failures"] == 1
+                # the strike registered on the victim's health machine
+                # (the fallback stream may then succeed on the same
+                # replica and clear `fails` — the lifetime counter is
+                # the monotonic record)
+                idx = 0 if victim == "prefill" else 1
+                assert router.replicas[idx].counters["failures"] >= 1
+                assert router.counters["midstream_errors"] == 0
+            finally:
+                await router.close()
+
+    asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
 # the full HTTP surface: router app on a port, replicas behind it
 
 
