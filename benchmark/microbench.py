@@ -273,6 +273,11 @@ def collect(iters: int = 20) -> list[dict]:
             (bench_qmatmul, (1, 4096, 12288), {"iters": iters}),  # merged qkv
             (bench_qmatmul, (1, 11008, 4096), {"iters": iters}),  # down
             (bench_qmatmul, (1, 4096, 32000), {"iters": iters}),  # lm head
+            # small-row decode shapes (M = concurrent decode rows in the
+            # fused tick): the qmatmul ladder rows ops/dispatch.py keys
+            # the int4-weight serving path on
+            (bench_qmatmul, (8, 4096, 12288), {"iters": iters}),
+            (bench_qmatmul, (8, 11008, 4096), {"iters": iters}),
             (bench_decode_attn, (1, 32, 32, 1280, 128), {"iters": iters}),
             (bench_decode_attn, (1, 32, 8, 4096, 128),
              {"dtype": jnp.float8_e5m2, "iters": iters}),         # fp8 KV
@@ -299,7 +304,15 @@ def collect(iters: int = 20) -> list[dict]:
         # interpret-mode shapes: small enough that the Pallas interpreter
         # (orders of magnitude slower than compiled) finishes in seconds
         jobs = [
+            # decode-shape qmatmul rows M=1..8 (interpret vs XLA): the
+            # measured pairs behind ops/dispatch.py's builtin
+            # qmatmul_sym_int4 CPU ladder row — XLA's fused block-dequant
+            # wins at every M here, so the int4-weight serving engine's
+            # CPU dispatch is provably data-driven, not a platform guess
             (bench_qmatmul, (1, 256, 512), {"iters": 2}),
+            (bench_qmatmul, (2, 256, 512), {"iters": 2}),
+            (bench_qmatmul, (4, 256, 512), {"iters": 2}),
+            (bench_qmatmul, (8, 256, 512), {"iters": 2}),
             (bench_decode_attn, (1, 8, 4, 256, 64), {"iters": 2}),
             (bench_decode_attn, (1, 8, 4, 256, 64),
              {"dtype": jnp.float8_e5m2, "iters": 2}),

@@ -202,6 +202,139 @@ def bench_kv_storage(cfg, params, engine_config, concurrency: int,
         eng.stop()
 
 
+def bench_weight_qtype(cfg, params, engine_config, n_in: int, n_out: int,
+                       base_rows: int = 4, seed: int = 17,
+                       max_rows_cap: int = 16) -> list[dict]:
+    """Fixed TOTAL HBM byte budget: weights + KV pool under ONE cap, the
+    number an operator actually provisions.  Two rows judged against each
+    other in-run:
+
+    - the **bf16 row**: full-width weights + a bf16 KV pool sized to back
+      exactly ``base_rows`` concurrent requests — total = weight bytes +
+      pool bytes is the shared cap;
+    - the **int4 row**: sym_int4-packed weights (the engine's
+      ``weight_qtype`` axis) + an fp8 KV pool handed the SAME total minus
+      the packed weight bytes — everything the packing freed becomes
+      half-width pages, so this row backs strictly more concurrent rows
+      at the same cap.
+
+    Both rows run at the SAME measured width (``2 * base_rows`` engine
+    rows, the PR 5 fp8-sweep protocol: equal-R programs keep tok/s
+    apples-to-apples — unequal widths measure XLA compile amortization
+    and drain-thread contention on a CPU host, not the byte story) and
+    serve the identical offered load in two waves (wave B repeating wave
+    A's prompts for the prefix-reuse signal).  The capacity axis is
+    ``rows_capacity``: how many in-flight requests' KV footprints the
+    row's residual budget actually BACKS (exact byte math, pages //
+    footprint) — the bf16 row's residual pool backs only ``base_rows``
+    of the 2x offered width, so its shortfall surfaces as the measured
+    thrash counters (prefix evictions, alloc-fail clamps, re-prefills),
+    while the int4 row's freed weight bytes back the full width and
+    more.  The gate — stamped on the int4 row — is ``rows_capacity``
+    strictly above bf16's with agg tok/s over the shared load no worse.
+    ``max_rows_cap`` bounds the reported capacity math only."""
+    from dataclasses import replace as _dc_replace
+
+    from ipex_llm_tpu.kv import paged_page_bytes
+    from ipex_llm_tpu.models.build import (dequantize_params, param_bytes,
+                                           requantize_params)
+    from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
+                                             ServingEngine)
+
+    ps = engine_config.page_size
+    f_pages = -(-(n_in + n_out) // ps)            # per-request footprint
+    pb = {s: paged_page_bytes(cfg.num_layers, cfg.num_kv_heads, ps,
+                              cfg.head_dim, v_head_dim=cfg.v_dim,
+                              storage=s) for s in ("bf16", "fp8")}
+    # both rows derive from the SAME model, at honest widths either way
+    # the caller's tree arrives: a full-width tree packs for the int4
+    # row, an already-packed tree (BENCH_QTYPE=sym_int4 rounds) expands
+    # to its dense twin for the bf16 baseline
+    p16 = dequantize_params(params)
+    w_bf16 = param_bytes(p16)[0]
+    p4 = requantize_params(params, "sym_int4")
+    w_int4 = param_bytes(p4)[0]
+    pool_bf16 = (base_rows * f_pages + 2) * pb["bf16"]
+    total = w_bf16 + pool_bf16                    # the one shared HBM cap
+    pool_int4 = total - w_int4
+    c = 2 * base_rows                             # measured engine width
+    cap16 = base_rows
+    cap4 = min(int((pool_int4 // pb["fp8"] - 2) // f_pages), max_rows_cap)
+
+    variants = [
+        ("bf16", p16, "bf16", None, pool_bf16, cap16),
+        ("sym_int4", p4, "fp8", "sym_int4", pool_int4, cap4),
+    ]
+    rng = np.random.default_rng(seed)
+    # ONE offered load for both rows, at the shared measured width
+    prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+               for _ in range(c)]
+    # full-size warm wave (distinct draws — reusing a measured prompt
+    # would hand it a cached prefill): the fused tick compiles a program
+    # variant per admission-wave shape, so a 2-stream warm-up leaves the
+    # full-width wave's variants compiling INSIDE the timed window and
+    # the row measures XLA's compiler, not the engine
+    warm = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+            for _ in range(c)]
+    out = []
+    for wq_name, p, storage, wq, kv_budget, capacity in variants:
+        ec = _dc_replace(engine_config, max_rows=c, kv_storage=storage,
+                         kv_pool_bytes=kv_budget, weight_qtype=wq)
+        eng = ServingEngine(cfg, p, ec).start()
+        try:
+            _warm(eng, warm, n_out=n_out)
+            reqs: list[Request] = []
+            outs: dict[int, list[int]] = {}
+            kv0 = eng.kv_stats()
+            t0 = time.perf_counter()
+            for wave in range(2):     # wave B re-sends wave A's prompts
+                wave_reqs = [Request(prompt_ids=pr, max_new_tokens=n_out)
+                             for pr in prompts]
+                reqs.extend(wave_reqs)
+                _run_wave(eng, wave_reqs, outs,
+                          key_offset=wave * len(prompts))
+            wall = time.perf_counter() - t0
+            kv = eng.kv_stats()
+            ws = eng.weight_stats()
+            total_tokens = sum(len(v) for v in outs.values())
+            ttfts = [r.first_token_s for r in reqs if r.first_token_s > 0]
+            out.append({
+                "workload": "weight_budget",
+                # the width actually SERVED (weight_stats derives it from
+                # the planes), not the variant label: an already-packed
+                # tree at another width must not mislabel the artifact
+                "weight_qtype": ws["qtype"] or wq_name,
+                "kv_storage": storage,
+                "total_hbm_bytes": ws["weight_bytes"] + kv["pool_bytes"],
+                "weight_bytes": ws["weight_bytes"],
+                "weight_bytes_saved": ws["bytes_saved"],
+                "kv_pool_bytes": kv["pool_bytes"],
+                "pages_total": kv["pages_total"],
+                "engine_rows": c,
+                "rows_capacity": capacity,
+                "n_in": n_in, "n_out": n_out,
+                "agg_tok_s": round(total_tokens / wall, 2),
+                "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+                "ttft_p95_s": round(_percentile(ttfts, 95), 4),
+                "prefix_evictions": (kv["prefix_evictions"]
+                                     - kv0["prefix_evictions"]),
+                "alloc_fail_clamps": (kv["alloc_fail_clamps"]
+                                      - kv0["alloc_fail_clamps"]),
+                "completed": sum(1 for r in reqs
+                                 if r.finish_reason in ("length", "stop")),
+            })
+        finally:
+            eng.stop()
+    # the gate rides the int4 row: the residual budget backs strictly
+    # more concurrent rows' KV than the bf16 row at the same total cap,
+    # with aggregate tok/s over the shared equal-width load no worse
+    r16, r4 = out
+    r4["gate_rows_gain"] = r4["rows_capacity"] > r16["rows_capacity"]
+    r4["gate_agg_ok"] = r4["agg_tok_s"] >= r16["agg_tok_s"]
+    r4["gate_pass"] = r4["gate_rows_gain"] and r4["gate_agg_ok"]
+    return out
+
+
 def bench_kv_spill(cfg, params, engine_config, concurrency: int,
                    n_in: int, n_out: int, spill_bytes: int,
                    n_waves: int = 4, seed: int = 13) -> dict:
@@ -1239,6 +1372,22 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
         except Exception as e:  # noqa: BLE001
             print(f"serving_bench skip kv_storage={storage}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+    # fixed TOTAL-HBM-budget weight-width pair (BENCH_r12+): int4+fp8KV
+    # vs bf16+bf16KV under ONE cap (weight bytes + pool bytes) — the
+    # bytes sym_int4 packing frees become extra half-width KV pages, so
+    # the int4 row must back strictly more concurrent rows with agg
+    # tok/s no worse (the gate is stamped on the int4 row).  The pair is
+    # honest whatever width `params` arrives at: the bf16 row serves the
+    # dense twin (dequantize_params), the int4 row the packed tree.
+    try:
+        # kv_ec IS the kv-sweep's engine shape — the weight pair shares
+        # its protocol on purpose (bench_weight_qtype overrides the
+        # budget/storage/width per variant itself)
+        out.extend(bench_weight_qtype(cfg, params, kv_ec,
+                                      n_in=kv_in, n_out=n_out))
+    except Exception as e:  # noqa: BLE001
+        print(f"serving_bench skip weight_qtype: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     # host-RAM spill tier pair (BENCH_r11+): the SAME fixed device
     # budget and a repeat-wave workload, untiered vs tiered — the tiered
     # row must sustain the prefix hit rate the untiered one loses to

@@ -40,6 +40,11 @@ TRACE_RULES: dict[str, tuple[str, str, str]] = {
         "tick-dispatch-count", ERROR,
         "the mixed prefill+decode tick issues more device dispatches than "
         "the gate allows, or its program set drifted from the registry"),
+    "JP107": (
+        "packed-weight-integrity", ERROR,
+        "a stacked packed-weight plane (the 4/5/8-bit block serving "
+        "formats) is dequantized wholesale inside the lowered program "
+        "instead of per-layer next to its matmul (a 4x HBM regression)"),
 }
 
 

@@ -82,17 +82,39 @@ def _sds(tree):
                    if hasattr(x, "shape") else x), tree)
 
 
-@lru_cache(maxsize=1)
-def audit_model():
+def audit_model(wq: str = "bf16"):
     """(cfg, abstract params) for a tiny llama through the REAL build
     path, so the param tree the audit lowers against is structurally the
-    tree every engine entry actually takes."""
+    tree every engine entry actually takes.  ``wq`` is the weight-qtype
+    axis (EngineConfig.weight_qtype): "sym_int4" lowers against stacked
+    packed-code planes — the tree JP107's packed-weight protection and
+    the int4 donation contracts are audited on.  The quantized variant
+    widens hidden/ffn (128/256 vs 32/64) AND the query-head count (16 vs
+    4, so the o-projection's contraction dim num_heads*head_dim is 128,
+    not one lone block) to keep every stacked weight at >= 4 quantization
+    blocks per matrix: at <= 2 blocks the per-layer ``[n_blocks, block,
+    out]`` dequant view inside the scan body (the DESIGN) shape-collides
+    with the full-stack ``[L, in, out]`` form JP107 forbids — a
+    toy-model-only ambiguity (real serving weights run thousands of
+    contraction rows), kept out of the audit by construction.  KV-head
+    and head dims stay equal across variants so both share one
+    paged-cache shape.  (Thin wrapper so ``audit_model()`` and
+    ``audit_model("bf16")`` normalize to ONE lru_cache key — the real
+    quantize work in random_params must not run twice per audit.)"""
+    return _audit_model(wq)
+
+
+@lru_cache(maxsize=4)
+def _audit_model(wq: str):
     from ipex_llm_tpu.models.random_init import llama_config, random_params
 
-    cfg = llama_config(hidden_size=32, intermediate_size=64, num_layers=2,
-                       num_heads=4, num_kv_heads=2, vocab_size=97,
+    wide = wq != "bf16"
+    cfg = llama_config(hidden_size=128 if wide else 32,
+                       intermediate_size=256 if wide else 64, num_layers=2,
+                       num_heads=16 if wide else 4, num_kv_heads=2,
+                       head_dim=8, vocab_size=97,
                        max_position_embeddings=256)
-    return cfg, _sds(random_params(cfg, qtype="bf16", seed=0))
+    return cfg, _sds(random_params(cfg, qtype=wq, seed=0))
 
 
 _POOL_PAGES = 18      # audit pool: pages, page size, table width
@@ -147,7 +169,7 @@ def _grid(**axes) -> tuple[dict, ...]:
 # --------------------------------------------------------------------------
 
 def _build_decode_multi_step(pt):
-    cfg, params = audit_model()
+    cfg, params = audit_model(pt.get("wq", "bf16"))
     r = pt["rows"]
     return (cfg, params, _paged_cache(r, pt["kv"]), _i32(r), _i32(r),
             _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r), _i32(r),
@@ -155,7 +177,7 @@ def _build_decode_multi_step(pt):
 
 
 def _build_ragged_tick(pt):
-    cfg, params = audit_model()
+    cfg, params = audit_model(pt.get("wq", "bf16"))
     r = pt["rows"]
     base = (cfg, params, _paged_cache(r, pt["kv"]), _i32(r), _i32(r),
             _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r), _i32(r),
@@ -304,7 +326,17 @@ def real_registry() -> tuple[ProgramSpec, ...]:
                   + _grid(rows=(4,), width=(0,), horizon=(1, 8),
                           spec=(4,), kv=kv_axis)
                   + _grid(rows=(4,), width=(8,), horizon=(1,),
-                          spec=(4,), kv=kv_axis)),
+                          spec=(4,), kv=kv_axis)
+                  # weight-qtype axis (EngineConfig.weight_qtype): the
+                  # tick over stacked int4-packed weight planes — steady
+                  # decode at both horizons on bf16+fp8 pools plus the
+                  # admission-wave joiner tick; JP107 protects the packed
+                  # stacks, JP101 re-verifies the donation map with the
+                  # params held (packed planes are never donated)
+                  + _grid(rows=(4,), width=(0,), horizon=(1, 8),
+                          wq=("sym_int4",), kv=kv_axis)
+                  + _grid(rows=(4,), width=(8,), horizon=(1,),
+                          wq=("sym_int4",), kv=("bf16",))),
             arg_names=("params", "cache", "toks", "row_lens", "active",
                        "temps", "top_ps", "key", "seeds", "steps",
                        "top_ks", "eos", "remain"),
@@ -318,13 +350,18 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             # purpose
             held=frozenset({"params", "temps", "top_ps", "seeds",
                             "top_ks", "eos", "key"}),
-            max_lowerings=20,
+            max_lowerings=25,
         ),
         ProgramSpec(
             name="serving.decode_multi_step",
             fn=engine._decode_multi_step,
             build=_build_decode_multi_step,
-            grid=_grid(rows=(4, 8), horizon=(1, 8), kv=kv_axis),
+            # + one int4-weight point: the chained-program oracle the
+            # low-bit equivalence suite drives must lower (and keep its
+            # donation map) over packed planes too
+            grid=(_grid(rows=(4, 8), horizon=(1, 8), kv=kv_axis)
+                  + _grid(rows=(4,), horizon=(1,), wq=("sym_int4",),
+                          kv=("bf16",))),
             arg_names=("params", "cache", "toks", "row_lens", "active",
                        "temps", "top_ps", "key", "seeds", "steps",
                        "top_ks", "eos", "remain"),
@@ -335,7 +372,7 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             # donating it would let a rollback restore a deleted buffer
             held=frozenset({"params", "temps", "top_ps", "seeds", "top_ks",
                             "eos", "key"}),
-            max_lowerings=8,
+            max_lowerings=9,
         ),
         ProgramSpec(
             name="serving.mixed_prefill",

@@ -98,6 +98,59 @@ def check_fp8_integrity(spec, entry: TracedEntry):
                 entry.point_key)
 
 
+def check_weight_integrity(spec, entry: TracedEntry):
+    """JP107: stacked packed-weight planes stay packed end to end — the
+    weight twin of JP102's fp8-pool rule.
+
+    Protected inputs are the uint8 code planes of stacked quantized
+    weights (``params`` leaves with >= 3 dims: ``[L, in_packed, out]``
+    layer stacks, ``[L, E, in_packed, out]`` expert stacks).  The
+    dequant-fused contract says a layer's weights widen only INSIDE the
+    scan body, per layer, right next to the matmul that consumes them —
+    per-layer 2-D wide tiles are the design, on both backends.  What must
+    never appear is the FULL-STACK wide form: a wide-float value of the
+    dense stack shape a wholesale dequant of the plane would produce —
+    ``lead + (in_pad, out)`` for in_pad/data-rows ratios 1 (byte-per-code
+    sym_int8/fp8/fp6), 2 (the nibble-packed 4-bit family, the serving
+    headline), and 8/5 (the dual-plane 5-bit layout, when the row count
+    divides).  That value is a full-width copy of every layer resident
+    in HBM: ~4x the bytes the packing paid for, silently, on every tick.
+    The two-level iquant/kquant layouts (non-integral row ratios over
+    256-row superblocks) are outside this shape protection — they are
+    import/offline formats, not the requantize-at-build serving family.
+
+    Known blind zone: a weight with <= 2 quantization blocks per matrix
+    whose block count equals the stack depth makes the per-layer
+    ``[n_blocks, block, out]`` view ambiguous with the full-stack form —
+    toy shapes only (real serving weights carry thousands of contraction
+    rows); the audit model keeps every weight at >= 4 blocks by
+    construction (registry.audit_model)."""
+    protected: set[tuple[int, ...]] = set()
+    for leaf in entry.leaves:
+        if leaf.arg == "params" and leaf.dtype == "uint8" \
+                and len(leaf.shape) >= 3:
+            lead, kp, n = leaf.shape[:-2], leaf.shape[-2], leaf.shape[-1]
+            for m in (1, 2):
+                protected.add(lead + (m * kp, n))
+            if kp * 8 % 5 == 0:    # _pack_5bit dual-plane rows = 5*in/8
+                protected.add(lead + (kp * 8 // 5, n))
+    if not protected:
+        return
+    seen: set[tuple[tuple[int, ...], str]] = set()
+    for shape, dtype in entry.eqn_avals + entry.out_avals:
+        if shape in protected and dtype in _WIDE_FLOATS \
+                and (shape, dtype) not in seen:
+            seen.add((shape, dtype))
+            yield finding(
+                spec, "JP107",
+                f"stacked-weight-shaped value {dtype}{list(shape)} "
+                "materializes inside the lowered program — a wholesale "
+                "dequant-upcast of a packed weight stack (~4x the HBM "
+                "bytes the packing bought); dequantize per layer inside "
+                "the scan body, next to the consuming matmul",
+                entry.point_key)
+
+
 def check_callbacks(spec, entry: TracedEntry):
     """JP103: hot programs must be host-callback-free."""
     if entry.callbacks:

@@ -20,6 +20,7 @@ from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.families import WeightScheme
 from ipex_llm_tpu.quantize import core as qcore
 from ipex_llm_tpu.quantize.core import QTensor
+from ipex_llm_tpu.quantize.qtypes import resolve as qtypes_resolve
 
 NORM_DTYPE = jnp.float32
 
@@ -61,6 +62,168 @@ def _imx(imatrix_data, layer: int, slot: str, expert: int | None = None):
 def stack_layer_trees(trees: list[dict[str, Any]]) -> dict[str, Any]:
     """Stack per-layer pytrees (QTensor-aware) along a new leading axis."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+# kinds requantize_params can re-pack a native-width weight into (what
+# quantize/core.py ships a codec for; kquant is import/dequant-only)
+_REQUANT_KINDS = ("int_sym", "int_asym", "codebook", "minifloat", "iquant")
+
+
+def _requant_leaf(qt: QTensor, qtype: str, imatrix=None) -> QTensor:
+    """Re-pack one native-width QTensor — per-layer 2-D or stacked with any
+    leading axes ([L, in, out] layer stacks, [L, E, in, out] expert stacks)
+    — into the block-quantized format ``qtype``.
+
+    Each logical ``[in, out]`` matrix quantizes independently through
+    ``quantize/core.quantize`` (the exact codec a load-time
+    ``load_in_low_bit`` build uses, here over the tree's stored — i.e.
+    bf16-rounded — values), then the packed planes restack along the
+    original leading axes.  ``imatrix`` is a
+    per-input-channel importance vector shared by every matrix in the
+    stack (callers with per-layer calibration pass a callable
+    ``imatrix(i)`` over the flattened leading index)."""
+    lead = qt.data.shape[:-2]
+    flat = qt.data.reshape((-1,) + tuple(qt.shape))
+    n = flat.shape[0]
+    qts = []
+    for i in range(n):
+        im = imatrix(i) if callable(imatrix) else imatrix
+        qts.append(qcore.quantize(flat[i], qtype, imatrix=im))
+    q0 = qts[0]
+
+    def restack(leaves):
+        s = jnp.stack(leaves) if n > 1 else leaves[0][None]
+        return s.reshape(lead + s.shape[1:]) if lead else s[0]
+
+    data = restack([q.data for q in qts])
+    scales = (restack([q.scales for q in qts])
+              if q0.scales is not None else None)
+    zeros = (restack([q.zeros for q in qts])
+             if q0.zeros is not None else None)
+    return QTensor(data, scales, zeros, q0.qtype, qt.shape, q0.block_size,
+                   qt.tp_mode)
+
+
+def requantize_params(params: dict[str, Any], qtype: str,
+                      imatrix_data: dict | None = None) -> dict[str, Any]:
+    """Re-pack every native-width (bf16/fp16) linear QTensor in a built
+    param tree as block-quantized ``qtype`` planes — the serving engine's
+    ``EngineConfig.weight_qtype`` axis (reference ``load_in_low_bit``, but
+    applied AFTER build so an engine can low-bit a tree that was loaded or
+    fabricated full-width).
+
+    Only QTensor leaves re-pack: plain arrays (embed table, norms, biases,
+    rope buffers) keep their width, and already-quantized leaves (a tree
+    loaded with ``load_in_low_bit="sym_int4"``) pass through untouched —
+    requantizing packed codes would stack quantization error, so a
+    different requested width on an already-low-bit tree is a no-op, not a
+    lossy rewrite.  ``imatrix_data`` is the llama.cpp importance-matrix
+    dict (quantize/imatrix.py), keyed "{layer}_{slot}" (+"_{expert}" for
+    MoE): layer stacks index it by stack position, and expert stacks
+    ``[L, E, ...]`` decompose the flat index into (layer, expert) — the
+    same keys the load-time build uses, so calibrated serving repacks
+    match calibrated loads."""
+    info = qtypes_resolve(qtype)
+    if info.kind == "native":
+        return params
+    packable = info.kind in _REQUANT_KINDS
+
+    from ipex_llm_tpu.quantize.imatrix import slot_importance
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, QTensor):
+            if qtypes_resolve(tree.qtype).kind != "native":
+                return tree   # already low-bit: pass through
+            if not packable:
+                # only an error when a full-width leaf actually needs the
+                # missing codec: an already-packed tree (kquant GGUF
+                # import served with --low-bit q4_k) passes through above
+                raise ValueError(
+                    f"weight_qtype={qtype!r} (kind={info.kind}) has no "
+                    f"requantize codec for full-width weight "
+                    f"{'.'.join(map(str, path))}; pick a block format "
+                    f"(kinds {_REQUANT_KINDS}) or a native width")
+            im = None
+            if imatrix_data is not None and path:
+                slot = path[-1]
+                lead = tree.data.shape[:-2]
+                if len(lead) >= 2:
+                    # [L, E, ...] expert stacks: flat index i decomposes
+                    # row-major into (layer, expert), and the tree key
+                    # ("moe_gate_up") maps back onto the load-time slot
+                    # ("gate_up" + expert — build_params' _imx keys)
+                    s = slot[4:] if slot.startswith("moe_") else slot
+                    ne = 1
+                    for d in lead[1:]:
+                        ne *= d
+                    im = lambda i, s=s, n=ne: slot_importance(  # noqa: E731
+                        imatrix_data, i // n, s, i % n)
+                elif lead:                # [L, ...] layer stacks
+                    im = lambda i, s=slot: slot_importance(  # noqa: E731
+                        imatrix_data, i, s)
+                else:                     # lm_head & friends: no layer key
+                    im = slot_importance(imatrix_data, 0, slot)
+            return _requant_leaf(tree, info.name, imatrix=im)
+        return tree
+
+    return walk(params)
+
+
+def dequantize_params(params: dict[str, Any],
+                      dtype=jnp.bfloat16) -> dict[str, Any]:
+    """Full-width twin of a param tree: every block-quantized QTensor
+    replaced by its dequantized dense stack (plain arrays and
+    native-width QTensors pass through).  The bitwise oracle the packed
+    tree's qmatmul path is tested against, and the honest bf16 baseline
+    ``bench_weight_qtype`` prices a packed tree against."""
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        if not isinstance(tree, QTensor) \
+                or qtypes_resolve(tree.qtype).kind == "native":
+            return tree
+        lead = tree.data.shape[:-2]
+        n = 1
+        for d in lead:
+            n *= d
+
+        def plane(leaf, i):
+            return (None if leaf is None
+                    else leaf.reshape((n,) + leaf.shape[len(lead):])[i])
+
+        flat = [qcore.dequantize(
+                    QTensor(plane(tree.data, i), plane(tree.scales, i),
+                            plane(tree.zeros, i), tree.qtype, tree.shape,
+                            tree.block_size), dtype=dtype)
+                for i in range(n)]
+        stacked = jnp.stack(flat)
+        return stacked.reshape(lead + flat[0].shape) if lead else stacked[0]
+
+    return walk(params)
+
+
+def param_bytes(params: dict[str, Any]) -> tuple[int, int]:
+    """(packed_bytes, dense_bytes) for a param tree: what the tree costs
+    in HBM as stored, vs what the same tree would cost with every QTensor
+    at bf16 full width (non-QTensor leaves count identically on both
+    sides).  The byte axis /health's ``weights`` block and the
+    fixed-budget ``bench_weight_qtype`` sweep report."""
+    packed = dense = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        if isinstance(leaf, QTensor):
+            packed += leaf.nbytes
+            n_mats = 1
+            for d in leaf.data.shape[:-2]:
+                n_mats *= d
+            dense += n_mats * leaf.in_features * leaf.out_features * 2
+        elif hasattr(leaf, "nbytes"):
+            packed += int(leaf.nbytes)
+            dense += int(leaf.nbytes)
+    return packed, dense
 
 
 def build_params(

@@ -113,6 +113,12 @@ _BUILTIN_LADDER: dict[str, dict[str, dict[str, float]]] = {
         "paged_decode_attn_fp8": {"pallas_us": 561.0, "xla_us": 493.0},
         "ragged_attn": {"pallas_us": 540.0, "xla_us": 268.0},
         "ragged_attn_fp8": {"pallas_us": 561.0, "xla_us": 493.0},
+        # fused dequant-matmul, decode shape (M=1, the serving weight
+        # read): BENCH_r12 interpret rows — the XLA block-dequant path
+        # wins at every M in 1..8 (M=1: 64.1 vs 15.1us; M=8: 40.2 vs
+        # 30.3us), so an int4-weight serving engine on CPU provably
+        # selects XLA instead of inheriting a blanket platform rule
+        "qmatmul_sym_int4": {"pallas_us": 64.1, "xla_us": 15.1},
     },
     "tpu": {},  # no recorded loss: platform default (pallas) stands
 }

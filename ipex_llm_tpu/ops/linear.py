@@ -36,17 +36,22 @@ def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndar
 
     The Pallas path currently covers the 4-bit packed formats (sym_int4 /
     asym_int4 / nf4 / fp4) and sym_int8 — the formats the reference routes to
-    ``xe_linear``/``xe_batch`` — and is gated on TPU availability.  Under an
-    active SPMD mesh, TP-sharded weights (``qt.tp_mode`` stamped by
-    parallel/shard.py) run the shard_map-wrapped kernel; everything else
-    falls back to the XLA dequant path which GSPMD partitions itself.
+    ``xe_linear``/``xe_batch`` — and the backend choice is data-driven per
+    qtype family from the measured microbench ladder
+    (``dispatch.use_pallas("qmatmul_<qtype>")``: the recorded decode-shape
+    rows show CPU-interpret losing to the XLA block-dequant path, TPU has
+    no recorded loss so the compiled kernel stands).  Under an active SPMD
+    mesh, TP-sharded weights (``qt.tp_mode`` stamped by parallel/shard.py)
+    run the shard_map-wrapped kernel; everything else falls back to the
+    XLA dequant path which GSPMD partitions itself.
     """
+    fam = f"qmatmul_{qt.qtype}"
     mesh = dispatch.spmd_mesh()
     if (
         mesh is not None
         and qt.tp_mode in ("col", "row")
         and mesh.shape.get("tp", 1) > 1
-        and dispatch.use_pallas_sharded()
+        and dispatch.use_pallas_sharded(fam)
         and qt.qtype in _PALLAS_QTYPES
     ):
         try:
@@ -57,7 +62,7 @@ def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndar
             )
         except (ImportError, NotImplementedError):
             pass
-    if dispatch.use_pallas() and qt.qtype in _PALLAS_QTYPES:
+    if dispatch.use_pallas(fam) and qt.qtype in _PALLAS_QTYPES:
         try:
             from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
 

@@ -467,6 +467,12 @@ class OpenAIServer:
         # operated on (capacity planning reads pool_bytes/pages_total,
         # incident triage reads the clamp/eviction counters)
         body["kv"] = self.engine.kv_stats()
+        # weight-pool economics, side by side with the kv block: the two
+        # byte lines (weights.weight_bytes + kv.pool_bytes) are the one
+        # HBM budget an operator provisions — int4 weights hand their
+        # saved bytes to the KV pool (more pages, more concurrent rows at
+        # the same cap; see bench_weight_qtype)
+        body["weights"] = self.engine.weight_stats()
         # fault-domain observability: admission backlog vs the bound (what
         # a 429 means), per-request failures isolated by bisection,
         # transient step retries, load-shed and deadline-expired counts
@@ -497,6 +503,9 @@ class OpenAIServer:
         for k, v in self.engine.kv_stats().items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"kv_{k}"] = v
+        for k, v in self.engine.weight_stats().items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"weights_{k}"] = v
         out["uptime_s"] = round(
             time.monotonic() - self.started_monotonic, 3)
         return out
@@ -745,7 +754,15 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
                  drain_timeout_s: float = 30.0) -> OpenAIServer:
     """``tensor_parallel_size`` > 1 serves under a tp mesh (SPMD AutoTP, the
     reference's vLLM-TP serving mode); a model already ``.shard(mesh)``-ed
-    passes its mesh through implicitly."""
+    passes its mesh through implicitly.
+
+    When build_server loads the checkpoint itself, ``low_bit`` threads
+    into ``EngineConfig.weight_qtype`` (unless the caller's engine_config
+    already pins one), so the SERVING stack owns the weight-width axis
+    end to end and /health's ``weights`` block reports it.  A model
+    handed in via ``model=`` keeps whatever width it carries — silently
+    requantizing a caller's full-width tree would be a lossy surprise;
+    such callers opt in via ``EngineConfig(weight_qtype=...)``."""
     from ipex_llm_tpu.transformers import AutoModelForCausalLM
 
     mesh = None
@@ -753,22 +770,38 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
         from ipex_llm_tpu.parallel import MeshSpec, make_mesh
 
         mesh = make_mesh(MeshSpec(tp=tensor_parallel_size))
+    ec = engine_config or EngineConfig()
     if model is None:
         import os
 
+        from ipex_llm_tpu.serving.engine import default_weight_qtype
+
         if os.path.exists(f"{model_path}/bigdl_config.json"):
+            # a save_low_bit checkpoint carries ITS OWN width — thread
+            # that, not the CLI --low-bit default, so a bf16 or nf4 save
+            # is never silently requantized (and /health never reports a
+            # width the tree does not hold)
             model = AutoModelForCausalLM.load_low_bit(model_path, mesh=mesh)
+            ec = default_weight_qtype(ec, getattr(model, "qtype", None))
         else:
+            # both halves of the width rule live in serving/engine.py: a
+            # pinned EngineConfig.weight_qtype outranks --low-bit for the
+            # LOAD (resolve_load_low_bit — the flag is authoritative end
+            # to end), and the loaded width threads back into the config
+            from ipex_llm_tpu.serving.engine import resolve_load_low_bit
+
+            load_q = resolve_load_low_bit(ec, low_bit)
             model = AutoModelForCausalLM.from_pretrained(
-                model_path, load_in_low_bit=low_bit, mesh=mesh
+                model_path, load_in_low_bit=load_q, mesh=mesh
             )
+            ec = default_weight_qtype(ec, load_q)
     if tokenizer is None:
         from transformers import AutoTokenizer
 
         tokenizer = AutoTokenizer.from_pretrained(model_path,
                                                   trust_remote_code=True)
     engine = ServingEngine(
-        model.config, model.params, engine_config,
+        model.config, model.params, ec,
         default_eos=model.generation_config.eos_token_id,
         mesh=mesh if mesh is not None else getattr(model, "mesh", None),
     ).start()
@@ -826,6 +859,17 @@ def main(argv=None):
                          "one device program.  Default: the prefill "
                          "bucket; 0 reverts to sequential one-row-one-"
                          "chunk admission")
+    ap.add_argument("--weight-qtype", default=None, metavar="QTYPE",
+                    help="serving weight width (default: --low-bit), "
+                         "authoritative end to end: the checkpoint loads "
+                         "at this width (sym_int4/nf4/sym_int8/...; a "
+                         "save_low_bit checkpoint keeps its own recorded "
+                         "width), any full-width linear weights re-pack "
+                         "at engine build, and the fused tick reads "
+                         "packed codes with dequant fused into the "
+                         "matmul — ~4.5 bits/weight of HBM traffic "
+                         "instead of 16.  /health reports packed bytes + "
+                         "bytes saved in its weights block")
     ap.add_argument("--kv-storage", default="bf16",
                     choices=("bf16", "fp8"), metavar="FMT",
                     help="paged KV pool storage format: bf16 (full width, "
@@ -872,6 +916,7 @@ def main(argv=None):
                      kv_storage=args.kv_storage,
                      kv_pool_bytes=args.kv_pool_bytes,
                      kv_spill_bytes=args.kv_spill_bytes,
+                     weight_qtype=args.weight_qtype,
                      max_queue=args.max_queue,
                      request_deadline_s=args.request_deadline,
                      max_step_retries=args.max_step_retries),

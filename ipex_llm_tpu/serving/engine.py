@@ -95,6 +95,28 @@ class EngineConfig:
     # finish), never inside the fused tick — JP106's one-dispatch tick
     # is untouched.  0 = evictions stay losses (the pre-spill engine).
     kv_spill_bytes: int = 0
+    # weight-quantization axis (the reference's identity feature,
+    # load_in_low_bit="sym_int4", applied to the SERVING hot path): at
+    # engine build, every native-width (bf16/fp16) linear weight in the
+    # stacked layer params — qkv/o/gate_up/down stacks, the lm head —
+    # re-packs into block-quantized QTensor planes
+    # (models/build.requantize_params, the same quantize/core.py codecs a
+    # low-bit checkpoint load uses), and the single compiled layer body
+    # routes those matmuls through ops/linear.qmatmul with dequant fused
+    # next to the MXU (Pallas on TPU, XLA-fused block dequant on CPU —
+    # the data-driven dispatch ladder decides).  Decode is HBM-bandwidth
+    # bound, so ~4.5 bits/weight instead of 16 is a direct tok/s and —
+    # at a fixed HBM byte budget, weights + KV pool together — a
+    # concurrency win (bytes the weights stop using become KV pages; see
+    # bench_weight_qtype).  Zero new device programs: the QTensor planes
+    # ride the existing param pytree through the one-dispatch tick, so
+    # JP106 stays ==1, and the JP107 trace rule fails the audit if a
+    # hot-path program ever materializes a full-width copy of a stacked
+    # packed weight.  None = serve the params at the width they were
+    # handed over (a tree loaded with load_in_low_bit is ALREADY packed
+    # and passes through untouched — requantizing packed codes would
+    # stack error, so weight_qtype on such a tree is a no-op).
+    weight_qtype: str | None = None
     prefill_bucket: int = 128   # chunked-prefill chunk length
     # speculative serving (reference ipex_llm_worker.py:57 `speculative`
     # load flag): >0 enables prompt-lookup speculative decode steps — each
@@ -180,6 +202,35 @@ class EngineConfig:
     @property
     def max_pages(self) -> int:
         return self.max_seq_len // self.page_size
+
+
+def resolve_load_low_bit(engine_config: EngineConfig | None,
+                         low_bit: str | None) -> str | None:
+    """The load-width half of the serving width rule (one definition for
+    both server entry points): a pinned ``EngineConfig.weight_qtype``
+    outranks the ``low_bit`` load argument — loading packed at one width
+    and asking the engine for another would leave the request a
+    warn-and-ignore (requantizing packed codes stacks error), so the
+    pinned width drives the checkpoint load itself."""
+    if engine_config is not None and engine_config.weight_qtype:
+        return engine_config.weight_qtype
+    return low_bit
+
+
+def default_weight_qtype(engine_config: EngineConfig | None,
+                         low_bit: str | None) -> EngineConfig:
+    """The config half of the serving width rule, beside
+    :func:`resolve_load_low_bit`: thread the width the checkpoint was
+    loaded at into ``EngineConfig.weight_qtype`` unless the caller
+    already pinned one.  Only meaningful when the server also LOADED the
+    checkpoint at that width (the repack is then a pass-through and the
+    config records the width truthfully) — callers handing in their own
+    full-width model must opt into repacking explicitly via
+    ``weight_qtype``, never get it silently."""
+    ec = engine_config or EngineConfig()
+    if ec.weight_qtype is None and low_bit:
+        ec = replace(ec, weight_qtype=low_bit)
+    return ec
 
 
 @dataclass
@@ -926,7 +977,8 @@ class ServingEngine:
     def __init__(self, cfg: ModelConfig, params: dict,
                  engine_config: EngineConfig | None = None,
                  default_eos: tuple[int, ...] = (),
-                 mesh=None, fault_injector: FaultInjector | None = None):
+                 mesh=None, fault_injector: FaultInjector | None = None,
+                 weight_imatrix: dict | None = None):
         """``mesh``: a ``jax.sharding.Mesh`` for TP serving — params are
         placed under the AutoTP rules and the paged pool's kv heads are
         sharded, the reference's vLLM-TP-worker serving mode
@@ -935,7 +987,13 @@ class ServingEngine:
 
         ``fault_injector``: a ``faults.FaultInjector`` whose scripted
         exceptions fire at the engine's guarded sites — the deterministic
-        test/chaos harness for the fault-domain layer."""
+        test/chaos harness for the fault-domain layer.
+
+        ``weight_imatrix``: optional llama.cpp importance-matrix dict
+        (quantize/imatrix.load_imatrix) calibrating the
+        ``EngineConfig.weight_qtype`` repack — the reference's
+        ``ggml_quantize_tensor_with_weights`` path, applied at engine
+        build."""
         if cfg.rope_2d:
             # chatglm v1 block positions need each row's prompt boundary
             # threaded through every step; generate() supports it, the paged
@@ -989,6 +1047,80 @@ class ServingEngine:
                     f" needs at least {floor} ({floor * self.page_bytes} "
                     f"bytes)")
             self.ec = replace(self.ec, pool_pages=pages)
+        # weight-quantization axis: re-pack native-width linear weights
+        # into block-quantized planes BEFORE device placement/sharding
+        # (shard_params stamps tp_mode on whatever planes it is handed).
+        # Already-low-bit trees pass through untouched; an unknown or
+        # non-requantizable qtype raises here, before any pool allocates.
+        from ipex_llm_tpu.models.build import param_bytes, requantize_params
+
+        if self.ec.weight_qtype is not None:
+            params = requantize_params(params, self.ec.weight_qtype,
+                                       imatrix_data=weight_imatrix)
+        # weight byte accounting for /health's weights block and the
+        # fixed-budget bench: what the tree costs as stored vs at bf16
+        # full width, plus the packed formats actually present (an
+        # already-quantized tree reports its real width even when
+        # weight_qtype is None)
+        self._weight_bytes, self._weight_dense_bytes = param_bytes(params)
+        from ipex_llm_tpu.quantize.core import QTensor as _QT
+        from ipex_llm_tpu.quantize.qtypes import resolve as _qresolve
+
+        self._weight_qtypes = tuple(sorted({
+            leaf.qtype for leaf in jax.tree_util.tree_leaves(
+                params, is_leaf=lambda x: isinstance(x, _QT))
+            if isinstance(leaf, _QT)
+            and _qresolve(leaf.qtype).kind != "native"}))
+        # the SERVED width (what /health's weights.qtype reports): the
+        # configured axis when it matches the planes; the planes' own
+        # format when the tree arrived already packed at a different (or
+        # no configured) width — the axis is a request, the planes are
+        # the truth.  A mismatching explicit request warns loudly: the
+        # pass-through is by design (requantizing packed codes stacks
+        # error), but the operator asked for a width they are not getting.
+        # canonical name from the start: an alias axis ("woq_int4",
+        # "fp8") must report the format the planes actually carry
+        resolved = (_qresolve(self.ec.weight_qtype).name
+                    if self.ec.weight_qtype is not None else None)
+        self._served_qtype = resolved
+        if self._weight_qtypes:
+            if len(self._weight_qtypes) > 1:
+                # more than one packed width in the tree (mixed-precision
+                # int8 head over an int4 body, heterogeneous GGUF): no
+                # single name is the served width — even one matching the
+                # request — so report "mixed" and let packed_qtypes carry
+                # the list
+                self._served_qtype = "mixed"
+            elif resolved not in self._weight_qtypes:
+                # the request (or its absence) names no plane actually in
+                # the tree: report the one format that IS served
+                self._served_qtype = self._weight_qtypes[0]
+            if resolved is not None and resolved not in self._weight_qtypes:
+                import warnings
+
+                warnings.warn(
+                    f"weight_qtype={self.ec.weight_qtype!r} requested but "
+                    f"the param tree is already packed as "
+                    f"{list(self._weight_qtypes)} — requantizing packed "
+                    "codes would stack quantization error, so the tree "
+                    "serves as-is (/health's weights block reports the "
+                    "served width)", stacklevel=2)
+        elif resolved is not None \
+                and _qresolve(resolved).kind != "native":
+            # a packed width was requested but nothing packed: the tree
+            # holds plain-array weights (a dequantized/dense twin), which
+            # the repack does not cover — it cannot tell a linear weight
+            # from an embed table in a bare array.  Report the truth
+            # (nothing is served at that width) and say so.
+            self._served_qtype = None
+            import warnings
+
+            warnings.warn(
+                f"weight_qtype={self.ec.weight_qtype!r} requested but the "
+                "param tree carries no quantizable QTensor leaves (plain "
+                "arrays repack does not cover) — serving full width; "
+                "build the tree through models/build (or load_in_low_bit)"
+                " for a packable tree", stacklevel=2)
         self.default_eos = default_eos
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         r = self.ec.max_rows
@@ -1268,6 +1400,27 @@ class ServingEngine:
         else:
             out["spill_enabled"] = False
         return out
+
+    def weight_stats(self) -> dict:
+        """Weight-pool observability for /health's ``weights`` block and
+        the fixed-byte-budget bench: the serving width axis
+        (``EngineConfig.weight_qtype`` plus the packed formats actually
+        present in the tree), what the params cost in HBM as stored, what
+        the same tree would cost at bf16 full width, and the bytes the
+        packing freed — the budget the KV pool is co-planned with
+        (``kv_pool_bytes`` + ``weight_bytes`` side by side is the one
+        HBM cap an operator provisions).  ``qtype`` is the width actually
+        SERVED (derived from the planes when the tree arrived packed at a
+        different width than requested — the mismatch warns at build);
+        ``requested_qtype`` echoes the config axis."""
+        return {
+            "qtype": self._served_qtype,
+            "requested_qtype": self.ec.weight_qtype,
+            "packed_qtypes": list(self._weight_qtypes),
+            "weight_bytes": self._weight_bytes,
+            "dense_bytes": self._weight_dense_bytes,
+            "bytes_saved": self._weight_dense_bytes - self._weight_bytes,
+        }
 
     def spec_stats(self) -> dict:
         """Speculative-decoding observability for /health and the bench

@@ -262,13 +262,29 @@ def build_worker(model_path: str, low_bit: str = "sym_int4",
 
     from ipex_llm_tpu.transformers import AutoModelForCausalLM
 
+    # the serving stack owns the weight-width axis end to end: both
+    # halves of the rule live in serving/engine.py — a pinned
+    # EngineConfig.weight_qtype outranks low_bit for the LOAD
+    # (resolve_load_low_bit), and the loaded width threads back into the
+    # config for truthful /health (default_weight_qtype).  The max_rows
+    # fallback must be applied BEFORE the defaulting rule so an absent
+    # engine_config still sizes the engine to the worker's concurrency
+    # limit (FastChatWorker's own `or` fallback never fires once a
+    # config object exists).
+    from ipex_llm_tpu.serving.engine import (default_weight_qtype,
+                                             resolve_load_low_bit)
+
+    load_q = resolve_load_low_bit(engine_config, low_bit)
     model = AutoModelForCausalLM.from_pretrained(model_path,
-                                                 load_in_low_bit=low_bit)
+                                                 load_in_low_bit=load_q)
     tok = AutoTokenizer.from_pretrained(model_path, trust_remote_code=True)
     names = model_names or [model_path.rstrip("/").split("/")[-1]]
+    ec = default_weight_qtype(
+        engine_config or EngineConfig(max_rows=limit_worker_concurrency),
+        load_q)
     return FastChatWorker(model, tok, names, controller_addr, worker_addr,
                           limit_worker_concurrency,
-                          engine_config=engine_config,
+                          engine_config=ec,
                           drain_timeout_s=drain_timeout_s)
 
 
@@ -282,6 +298,12 @@ def main(argv=None):
     ap.add_argument("--worker-address", default=None)
     ap.add_argument("--model-names", default=None)
     ap.add_argument("--limit-worker-concurrency", type=int, default=8)
+    ap.add_argument("--weight-qtype", default=None, metavar="QTYPE",
+                    help="serving weight width (default: --low-bit), "
+                         "authoritative end to end: the checkpoint loads "
+                         "at this width, full-width weights re-pack at "
+                         "engine build, and the fused tick reads packed "
+                         "codes with dequant fused into the matmul")
     ap.add_argument("--kv-storage", default="bf16",
                     choices=("bf16", "fp8"), metavar="FMT",
                     help="paged KV pool storage: bf16 (default) or fp8 "
@@ -317,6 +339,7 @@ def main(argv=None):
                      drain_timeout_s=args.drain_timeout,
                      engine_config=EngineConfig(
                          max_rows=args.limit_worker_concurrency,
+                         weight_qtype=args.weight_qtype,
                          kv_storage=args.kv_storage,
                          kv_pool_bytes=args.kv_pool_bytes,
                          spec_k=args.spec_k, spec_ngram=args.spec_ngram,

@@ -214,6 +214,90 @@ def test_jp102_quiet_without_fp8_inputs():
 
 
 # --------------------------------------------------------------------------
+# JP107 packed-weight integrity
+# --------------------------------------------------------------------------
+
+_W_STACK = (2, 64, 128)        # [L, in_packed, out] nibble-packed planes
+
+
+@jax.jit
+def _fx_weight_wholesale(params, x):
+    # dequantize the WHOLE stack up front: the [L, 2*in_packed, out] wide
+    # form JP107 forbids (a full-width HBM copy of every layer's weights)
+    p = params.astype(jnp.int32)
+    codes = jnp.concatenate([p & 0x0F, p >> 4], axis=1)      # [L, in, out]
+    w = codes.astype(jnp.float32) - 8.0
+    return jnp.einsum("mi,lio->lmo", x, w).sum(axis=0), params
+
+
+@jax.jit
+def _fx_weight_per_layer(params, x):
+    # the dequant-fused design: each layer's plane widens INSIDE the scan
+    # body, right next to the matmul that consumes it (a per-layer 2-D
+    # tile, never the full stack)
+    def body(acc, plane):
+        p = plane.astype(jnp.int32)
+        codes = jnp.concatenate([p & 0x0F, p >> 4], axis=0)  # [in, out]
+        w = codes.astype(jnp.float32) - 8.0
+        return acc + x @ w, None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((x.shape[0], _W_STACK[2]), jnp.float32), params)
+    return acc, params
+
+
+def _weight_build(pt):
+    return (sds(*_W_STACK, dtype=jnp.uint8),
+            sds(4, 2 * _W_STACK[1])), {}
+
+
+WEIGHT_SPEC = dict(build=_weight_build, arg_names=("params", "x"),
+                   held=frozenset({"params"}))
+
+
+def test_jp107_fires_on_wholesale_stack_dequant():
+    spec = mkspec(_fx_weight_wholesale, **WEIGHT_SPEC)
+    found = list(jp.check_weight_integrity(spec, _entry(spec)))
+    assert [f.rule for f in found] == ["JP107"]
+    assert "wholesale" in found[0].message
+
+
+def test_jp107_quiet_on_per_layer_dequant_in_scan():
+    spec = mkspec(_fx_weight_per_layer, **WEIGHT_SPEC)
+    assert list(jp.check_weight_integrity(spec, _entry(spec))) == []
+
+
+def test_jp107_quiet_without_packed_inputs():
+    spec = mkspec(_fx_donated, **STATE_SPEC)
+    assert list(jp.check_weight_integrity(spec, _entry(spec))) == []
+
+
+_W5_STACK = (2, 40, 128)   # dual-plane 5-bit rows = 5*in/8 -> in = 64
+
+
+@jax.jit
+def _fx_weight5_wholesale(params, x):
+    # materializes the [L, in, out] dense form of a 5-bit plane stack
+    w = jnp.broadcast_to(params[:, :1, :].astype(jnp.float32),
+                         (_W5_STACK[0], _W5_STACK[1] * 8 // 5,
+                          _W5_STACK[2]))
+    return jnp.einsum("mi,lio->lmo", x, w).sum(axis=0), params
+
+
+def test_jp107_covers_5bit_plane_ratio():
+    """The dual-plane 5-bit layout (quantize/core._pack_5bit: data rows =
+    5*in/8) is protected too — its dense stack shape is neither 1x nor
+    2x the plane rows, so the rule carries the 8/5 ratio explicitly."""
+    spec = mkspec(
+        _fx_weight5_wholesale,
+        build=lambda pt: ((sds(*_W5_STACK, dtype=jnp.uint8),
+                           sds(4, _W5_STACK[1] * 8 // 5)), {}),
+        arg_names=("params", "x"), held=frozenset({"params"}))
+    found = list(jp.check_weight_integrity(spec, _entry(spec)))
+    assert [f.rule for f in found] == ["JP107"]
+
+
+# --------------------------------------------------------------------------
 # JP103 host callbacks / JP105 constant bloat
 # --------------------------------------------------------------------------
 
@@ -443,6 +527,38 @@ def test_real_registry_covers_fp8_and_bf16_grids():
         if spec.name in pool_programs:
             kvs = {pt["kv"] for pt in spec.grid}
             assert kvs == {"bf16", "fp8"}, spec.name
+
+
+def test_real_registry_covers_weight_qtype_axis():
+    """The tick (and its chained oracle) audit over stacked int4-packed
+    weight planes too: the wq axis covers steady decode at both horizons
+    on bf16+fp8 pools plus the admission-wave joiner tick — the grid
+    JP107's packed-weight protection actually runs on."""
+    specs = {s.name: s for s in real_registry()}
+    tick_wq = [pt for pt in specs["serving.ragged_tick"].grid
+               if pt.get("wq") == "sym_int4"]
+    assert {(pt["width"], pt["horizon"]) for pt in tick_wq} == {
+        (0, 1), (0, 8), (8, 1)}
+    assert {pt["kv"] for pt in tick_wq} == {"bf16", "fp8"}
+    assert any(pt.get("wq") == "sym_int4"
+               for pt in specs["serving.decode_multi_step"].grid)
+
+
+def test_manifest_locks_int4_tick_donation_map():
+    """The int4 grid points keep the tick's donation contract: the
+    device-state set aliases, while the packed weight planes (params) and
+    the rest of the held set never do — a donated plane would be freed
+    under the host's feet on the very next tick."""
+    lock = json.loads(manifest_mod.DEFAULT_PATH.read_text())
+    entries = lock["programs"]["serving.ragged_tick"]["entries"]
+    wq_entries = {k: v for k, v in entries.items() if "wq=sym_int4" in k}
+    assert wq_entries, "weight-qtype grid points missing from the manifest"
+    for key, entry in wq_entries.items():
+        aliased = {a.split("[")[0] for a in entry["aliases"]}
+        assert {"cache", "toks", "row_lens", "active", "steps",
+                "remain"} <= aliased, key
+        assert not aliased & {"params", "temps", "top_ps", "seeds",
+                              "top_ks", "eos", "key"}, key
 
 
 def test_real_registry_names_every_issue_entry():

@@ -1,0 +1,471 @@
+"""int4 weight pool behind the one-dispatch serving engine — the
+``EngineConfig.weight_qtype`` axis.
+
+The contracts under test (the low-bit-serving PR, ROADMAP item 3):
+
+- **repack mechanics**: ``weight_qtype="sym_int4"`` re-packs every
+  native-width linear weight in the stacked layer params (qkv/o/gate_up/
+  down stacks, the lm head) into block-quantized QTensor planes through
+  the real ``quantize/core.py`` codecs, leaves the embed table and norms
+  alone, passes an already-packed tree through untouched, and is
+  deterministic (two independently-built engines hold bit-identical
+  planes);
+- **engine-path bit-identity under int4** (the PR 5 fp8 pattern: lossy
+  vs bf16, self-consistent across paths): mixed admission ≡ sequential,
+  and fused H8 ≡ H1, both over int4 weights — token streams AND
+  logprobs;
+- **qmatmul ≡ dequant-reference on the real layer body**: the decoder
+  forward over packed planes is bitwise the forward over the
+  pre-dequantized bf16 tree (the packing moved bytes, not math);
+- **fault-domain composition**: a transient fault mid-tick over int4
+  weights rolls back and retries bit-identically;
+- **dispatch ladder**: the recorded qmatmul rows provably select XLA on
+  CPU-interpret, and a re-measured dump re-decides the backend;
+- **byte accounting**: ``weight_stats()``/the ``/health`` weights block
+  report packed bytes, bf16-equivalent bytes, and the savings the KV
+  pool is co-budgeted with.
+
+Plus a slow-marked quality gate mirroring PR 5's fp8 gate: a >=64-step
+greedy stream is self-consistent across horizons, and the int4
+sliding-ppl ratio vs bf16 stays < 1.25.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.kv import KVCache
+from ipex_llm_tpu.models.build import (
+    dequantize_params,
+    param_bytes,
+    requantize_params,
+)
+from ipex_llm_tpu.models.decoder import decoder_forward
+from ipex_llm_tpu.quantize.core import QTensor
+from ipex_llm_tpu.serving.engine import (
+    EngineConfig,
+    Request,
+    ServingEngine,
+    stream_tokens,
+)
+from ipex_llm_tpu.serving.faults import FaultInjector, TransientFault
+from tests.test_decoder import rand_params, tiny_cfg
+from tests.test_serving_mixed import _drive
+
+RNG = np.random.default_rng(93)
+
+EC = dict(max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=32)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=131, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+# -- repack mechanics --------------------------------------------------------
+
+def test_repack_packs_linear_stacks_and_lm_head(cfg_params):
+    """The weight axis re-packs exactly the linear weights: stacked layer
+    QTensors and the lm head become uint8 int4 planes (packed rows = half
+    the padded contraction rows), embed/norms keep their width, and the
+    byte accounting shows the ~4.5 bits/weight the format promises."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(weight_qtype="sym_int4", **EC))
+    lt = eng.params["layers"]
+    for key in ("qkv", "o", "gate_up", "down"):
+        qt = lt[key]
+        assert isinstance(qt, QTensor) and qt.qtype == "sym_int4", key
+        assert qt.data.dtype == jnp.uint8
+        # stacked planes: [L, in_pad/2, out] with the logical shape intact
+        in_pad = -(-qt.in_features // qt.block_size) * qt.block_size
+        assert qt.data.shape == (cfg.num_layers, in_pad // 2,
+                                 qt.out_features), key
+    head = eng.params["lm_head"]
+    assert isinstance(head, QTensor) and head.qtype == "sym_int4"
+    assert eng.params["embed"].dtype == jnp.bfloat16   # gather path, untouched
+    assert eng.params["final_norm"].dtype == jnp.float32
+
+    ws = eng.weight_stats()
+    assert ws["qtype"] == "sym_int4"
+    assert ws["packed_qtypes"] == ["sym_int4"]
+    assert ws["weight_bytes"] + ws["bytes_saved"] == ws["dense_bytes"]
+    # the linear weights dominate this tree: packed must be well under
+    # half the bf16 footprint (int4 codes + fp16 scales ~ 4.5/16 bits)
+    assert ws["weight_bytes"] < ws["dense_bytes"] * 0.5, ws
+
+
+def test_repack_deterministic_and_packed_tree_passes_through(cfg_params):
+    """Two independently-built int4 engines hold bit-identical planes
+    (the repack is a pure function of the tree), and handing the engine
+    an ALREADY-packed tree is a pass-through — requantizing packed codes
+    would stack quantization error, so it must not happen."""
+    cfg, params = cfg_params
+    e1 = ServingEngine(cfg, params,
+                       EngineConfig(weight_qtype="sym_int4", **EC))
+    e2 = ServingEngine(cfg, params,
+                       EngineConfig(weight_qtype="sym_int4", **EC))
+    for key in ("qkv", "down"):
+        np.testing.assert_array_equal(
+            np.asarray(e1.params["layers"][key].data),
+            np.asarray(e2.params["layers"][key].data))
+        np.testing.assert_array_equal(
+            np.asarray(e1.params["layers"][key].scales, np.float32),
+            np.asarray(e2.params["layers"][key].scales, np.float32))
+    # pass-through: repacking e1's already-int4 tree (even at a DIFFERENT
+    # requested width) returns the identical leaf objects
+    repacked = requantize_params(e1.params, "nf4")
+    assert repacked["layers"]["qkv"] is e1.params["layers"]["qkv"]
+    assert repacked["lm_head"] is e1.params["lm_head"]
+    # and a codec-less width on an ALREADY-packed tree is a pass-through
+    # too, not a startup crash — build_server threads --low-bit q4_k into
+    # weight_qtype for GGUF kquant checkpoints, whose leaves are packed
+    # (requantize has nothing to do); only a full-width leaf that would
+    # actually need the missing codec raises (covered below)
+    assert requantize_params(e1.params, "q4_k")["layers"]["qkv"] \
+        is e1.params["layers"]["qkv"]
+
+
+def test_mismatched_width_on_packed_tree_warns_and_reports_served(cfg_params):
+    """An explicit width over an already-packed tree is a by-design
+    pass-through, but never a silent one: the build warns, and /health's
+    weights.qtype reports the width actually served (the planes), with
+    the ignored request echoed in requested_qtype."""
+    cfg, params = cfg_params
+    p4 = requantize_params(params, "sym_int4")
+    with pytest.warns(UserWarning, match="already packed"):
+        eng = ServingEngine(cfg, p4, EngineConfig(weight_qtype="nf4", **EC))
+    ws = eng.weight_stats()
+    assert ws["qtype"] == "sym_int4"          # the truth
+    assert ws["requested_qtype"] == "nf4"     # the ignored ask
+    assert ws["packed_qtypes"] == ["sym_int4"]
+    # a tree packed at MORE THAN ONE width (mixed-precision: int8 head
+    # over an int4 body) reports "mixed" with packed_qtypes carrying the
+    # list — even when the request matches ONE of the planes, a single
+    # name would claim a uniformity the tree does not have
+    p_mixed = dict(p4, lm_head=requantize_params(
+        {"lm_head": params["lm_head"]}, "sym_int8")["lm_head"])
+    with pytest.warns(UserWarning, match="already packed"):
+        eng2 = ServingEngine(cfg, p_mixed,
+                             EngineConfig(weight_qtype="nf4", **EC))
+    assert eng2.weight_stats()["qtype"] == "mixed"
+    eng3 = ServingEngine(cfg, p_mixed,
+                         EngineConfig(weight_qtype="sym_int4", **EC))
+    ws3 = eng3.weight_stats()
+    assert ws3["qtype"] == "mixed"            # matching request: still mixed
+    assert ws3["requested_qtype"] == "sym_int4"
+    assert ws3["packed_qtypes"] == ["sym_int4", "sym_int8"]
+
+
+def test_plain_array_tree_warns_and_reports_unpacked(cfg_params):
+    """A packed width requested over a tree with NO QTensor leaves (a
+    dequantized dense twin — bare arrays, which the repack cannot tell
+    apart from embed tables) must not let /health claim a width nothing
+    serves: the build warns and qtype reports None."""
+    cfg, params = cfg_params
+    dense = dequantize_params(requantize_params(params, "sym_int4"))
+    with pytest.warns(UserWarning, match="no quantizable"):
+        eng = ServingEngine(cfg, dense,
+                            EngineConfig(weight_qtype="sym_int4", **EC))
+    ws = eng.weight_stats()
+    assert ws["qtype"] is None
+    assert ws["requested_qtype"] == "sym_int4"
+    assert ws["packed_qtypes"] == [] and ws["bytes_saved"] == 0
+
+
+def test_alias_width_reports_canonical(cfg_params):
+    """A registered alias axis ("woq_int4" -> sym_int4) packs — and
+    reports — the canonical format; the raw alias survives only in
+    requested_qtype."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(weight_qtype="woq_int4", **EC))
+    ws = eng.weight_stats()
+    assert ws["qtype"] == "sym_int4"
+    assert ws["requested_qtype"] == "woq_int4"
+    assert ws["packed_qtypes"] == ["sym_int4"]
+
+
+def test_engine_rejects_unknown_and_unrequantizable_qtype(cfg_params):
+    cfg, params = cfg_params
+    with pytest.raises(ValueError, match="load_in_low_bit"):
+        ServingEngine(cfg, params, EngineConfig(weight_qtype="int3", **EC))
+    with pytest.raises(ValueError, match="requantize"):
+        ServingEngine(cfg, params, EngineConfig(weight_qtype="q4_k", **EC))
+    # a native width is a no-op, not an error
+    eng = ServingEngine(cfg, params, EngineConfig(weight_qtype="bf16", **EC))
+    assert eng.weight_stats()["packed_qtypes"] == []
+
+
+# -- qmatmul ≡ dequant-reference on the real layer body ----------------------
+
+def test_layer_body_matches_dequant_reference_bitwise(cfg_params):
+    """The real decoder forward over int4 planes produces bitwise the
+    logits of the same forward over the pre-dequantized bf16 tree
+    (models/build.dequantize_params, the full-width twin): the qmatmul
+    path (dequant fused next to the matmul) moves HBM bytes, not math.
+    This is the oracle the Pallas kernel path is also held to (ops-level
+    kernel equivalence lives in test_pallas/test_quantize)."""
+    cfg, params = cfg_params
+    p4 = requantize_params(params, "sym_int4")
+    dense = dequantize_params(p4)
+    b, t = 2, 12
+    tokens = jnp.asarray(RNG.integers(0, cfg.vocab_size, (b, t)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+
+    def run(p):
+        cache = KVCache.init(cfg.num_layers, b, t, cfg.num_kv_heads,
+                             cfg.head_dim)
+        logits, _ = decoder_forward(cfg, p, tokens, cache, pos)
+        return np.asarray(logits)
+
+    np.testing.assert_array_equal(run(p4), run(dense))
+
+
+# -- engine-path bit-identity under int4 -------------------------------------
+
+def _wave_specs(cfg):
+    p1 = list(RNG.integers(0, cfg.vocab_size, 40))
+    p2 = list(RNG.integers(0, cfg.vocab_size, 70))
+    p3 = list(RNG.integers(0, cfg.vocab_size, 24))
+    return [
+        dict(prompt_ids=p1, max_new_tokens=12),
+        dict(prompt_ids=p2, max_new_tokens=12, temperature=0.8, top_p=0.9,
+             top_k=40, seed=123),
+        dict(prompt_ids=p3, max_new_tokens=12),
+    ]
+
+
+def test_mixed_vs_sequential_bit_identical_int4(cfg_params):
+    """The PR 2 equivalence contract survives the weight width: mixed
+    admission over int4 weights emits the exact token AND logprob streams
+    of the sequential int4 engine (both lossy vs bf16 in the same way)."""
+    cfg, params = cfg_params
+    specs = _wave_specs(cfg)
+    schedule = lambda: {0: [Request(**specs[0])], 1: [Request(**specs[1])],
+                        3: [Request(**specs[2])]}
+
+    sched_m = schedule()
+    eng_m = ServingEngine(cfg, params,
+                          EngineConfig(weight_qtype="sym_int4", **EC))
+    streams_m = _drive(eng_m, sched_m)
+    sched_s = schedule()
+    eng_s = ServingEngine(
+        cfg, params,
+        EngineConfig(weight_qtype="sym_int4", step_token_budget=0, **EC))
+    streams_s = _drive(eng_s, sched_s)
+
+    assert eng_m.metrics["mixed_steps"] > 0
+    assert eng_s.metrics["mixed_steps"] == 0
+    assert eng_m.params["layers"]["qkv"].qtype == "sym_int4"
+    for a, b in zip(streams_m, streams_s):
+        assert a == b, (a, b)
+    reqs_m = [r for rs in sched_m.values() for r in rs]
+    reqs_s = [r for rs in sched_s.values() for r in rs]
+    for a, b in zip(reqs_m, reqs_s):
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_array_equal(
+            np.asarray(a.logprobs, np.float32),
+            np.asarray(b.logprobs, np.float32))
+
+
+def test_fused_h8_bit_identical_to_h1_int4(cfg_params):
+    """The PR 1 equivalence contract over packed weights: H=8 fused
+    decode emits the H=1 int4 engine's exact streams (greedy and seeded
+    sampled)."""
+    cfg, params = cfg_params
+    p1 = list(RNG.integers(0, cfg.vocab_size, 9))
+    p2 = list(RNG.integers(0, cfg.vocab_size, 17))
+    specs = [
+        dict(prompt_ids=p1, max_new_tokens=16),
+        dict(prompt_ids=p2, max_new_tokens=16, temperature=0.8,
+             top_p=0.9, top_k=40, seed=123),
+    ]
+
+    def run(h):
+        sched = {0: [Request(**s) for s in specs]}
+        eng = ServingEngine(cfg, params, EngineConfig(
+            weight_qtype="sym_int4", decode_horizon=h, **EC))
+        streams = _drive(eng, sched)
+        return [r for rs in sched.values() for r in rs], streams, eng
+
+    r1, s1, _ = run(1)
+    r8, s8, e8 = run(8)
+    for a, b in zip(s1, s8):
+        assert a == b, (a, b)
+    for a, b in zip(r1, r8):
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_array_equal(
+            np.asarray(a.logprobs, np.float32),
+            np.asarray(b.logprobs, np.float32))
+    assert e8.metrics["decode_horizon_effective"] == 8
+    assert e8.metrics["host_syncs"] < e8.metrics["steps"]
+
+
+# -- fault-domain composition ------------------------------------------------
+
+def _drive_ticks(eng, reqs, max_ticks=3000):
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(max_ticks):
+        eng._tick()
+        if all(r.finish_reason is not None for r in reqs):
+            break
+    assert all(r.finish_reason is not None for r in reqs)
+    return [list(stream_tokens(r, timeout=10)) for r in reqs]
+
+
+def test_transient_fault_rollback_over_int4_tick(cfg_params):
+    """A transient fault mid-tick over int4 weights: rollback + retry
+    reproduces the unfaulted int4 run bit-for-bit (the packed planes are
+    held, never donated, so a replayed tick reads the same codes)."""
+    cfg, params = cfg_params
+    prompts = [list(RNG.integers(0, cfg.vocab_size, n)) for n in (40, 70)]
+
+    def wave():
+        return [Request(prompt_ids=p, max_new_tokens=8) for p in prompts]
+
+    ec = EngineConfig(weight_qtype="sym_int4", retry_backoff_s=0.001, **EC)
+    base_streams = _drive_ticks(ServingEngine(cfg, params, ec), wave())
+
+    inj = FaultInjector().inject("decode-dispatch", TransientFault, nth=2)
+    eng = ServingEngine(cfg, params, ec, fault_injector=inj)
+    reqs = wave()
+    streams = _drive_ticks(eng, reqs)
+    assert inj.fired == 1
+    assert eng.metrics["retries"] == 1
+    assert streams == base_streams
+    assert all(r.finish_reason == "length" for r in reqs)
+    # the packed planes survived the rollback's epoch re-upload untouched
+    assert eng.params["layers"]["qkv"].data.dtype == jnp.uint8
+
+
+# -- dispatch ladder ---------------------------------------------------------
+
+def test_qmatmul_ladder_selects_xla_on_cpu_interpret(monkeypatch):
+    """The recorded decode-shape qmatmul rows (M=1..8, interpret vs XLA —
+    BENCH_r12) must provably select the XLA block-dequant path on this
+    CPU environment, instead of a blanket platform rule."""
+    from ipex_llm_tpu.ops import dispatch
+
+    monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+    dispatch.clear_cache()
+    try:
+        assert dispatch.backend_platform() == "cpu"
+        assert dispatch.ladder_prefers_pallas("qmatmul_sym_int4") is False
+        assert dispatch.use_pallas("qmatmul_sym_int4") is False
+        # a qtype family the ladder is silent on: platform default
+        assert dispatch.ladder_prefers_pallas("qmatmul_nf4") is None
+        assert dispatch.use_pallas("qmatmul_nf4") is False
+    finally:
+        dispatch.clear_cache()
+
+
+def test_qmatmul_ladder_is_data_driven(monkeypatch, tmp_path):
+    """A re-measured collect() dump re-decides the qmatmul backend —
+    recording the kernel faster turns the Pallas path on — and the
+    microbench row names map onto the qmatmul_<qtype> family the
+    ops/linear.py dispatch keys on."""
+    from ipex_llm_tpu.ops import dispatch
+
+    rows = [{"op": "qmatmul_sym_int4_m1_256x512",
+             "pallas_us": 10.0, "xla_us": 50.0, "interpret": True}]
+    path = tmp_path / "ladder.json"
+    path.write_text(json.dumps(rows))
+    monkeypatch.delenv("IPEX_LLM_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("IPEX_LLM_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.setenv("IPEX_LLM_TPU_DISPATCH_LADDER", str(path))
+    dispatch.clear_cache()
+    try:
+        assert dispatch.use_pallas("qmatmul_sym_int4") is True
+        monkeypatch.setenv("IPEX_LLM_TPU_DISABLE_PALLAS", "1")
+        dispatch.clear_cache()
+        assert dispatch.use_pallas("qmatmul_sym_int4") is False
+    finally:
+        dispatch.clear_cache()
+
+
+# -- /health weights block ---------------------------------------------------
+
+def test_health_weights_block_reports_packed_bytes(cfg_params):
+    """End-to-end /health: the weights block rides next to the kv block
+    — qtype, packed bytes, bf16-equivalent bytes, bytes saved — and the
+    flat /metrics exposition carries the numeric series."""
+    pytest.importorskip("aiohttp")
+    from ipex_llm_tpu.serving.api_server import OpenAIServer
+    from tests.test_serving_faults import _Tok, _spin_server
+
+    cfg, params = cfg_params
+    packed, dense = param_bytes(requantize_params(params, "sym_int4"))
+    eng = ServingEngine(cfg, params,
+                        EngineConfig(weight_qtype="sym_int4", **EC)).start()
+    srv = OpenAIServer(eng, _Tok(), "tiny")
+    loop, port = _spin_server(srv)
+    try:
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=30).read())
+        w = health["weights"]
+        assert w["qtype"] == "sym_int4"
+        assert w["weight_bytes"] == packed
+        assert w["dense_bytes"] == dense
+        assert w["bytes_saved"] == dense - packed > 0
+        assert "kv" in health            # side by side with the pool bytes
+        metrics = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?format=json",
+            timeout=30).read())["metrics"]
+        assert metrics["weights_weight_bytes"] == packed
+        assert metrics["weights_bytes_saved"] == dense - packed
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        eng.stop()
+
+
+# -- quality gate (slow tier) ------------------------------------------------
+
+@pytest.mark.slow
+def test_int4_quality_gate_long_greedy_and_ppl_ratio(cfg_params):
+    """Slow quality gate for int4 weights (the PR 5 fp8 pattern): (1) a
+    >=64-step greedy stream through the int4 engine is self-consistent
+    across horizons (H=8 reproduces H=1 bit-for-bit); (2) the int4
+    sliding-ppl ratio vs the bf16 tree stays < 1.25 on the builtin
+    corpus — the reference ships sym_int4 as its headline production
+    format, and the engine's planes are the same codec."""
+    cfg, params = cfg_params
+    prompt = list(RNG.integers(0, cfg.vocab_size, 24))
+
+    def run(h):
+        eng = ServingEngine(cfg, params, EngineConfig(
+            max_rows=2, max_seq_len=256, page_size=32, prefill_bucket=32,
+            weight_qtype="sym_int4", decode_horizon=h))
+        (stream,) = _drive(eng, {0: [Request(prompt_ids=prompt,
+                                             max_new_tokens=96)]},
+                           max_ticks=6000)
+        return stream
+
+    s1, s8 = run(1), run(8)
+    assert len(s1) == 96 and s1 == s8
+
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "benchmark")
+    sys.path.insert(0, bench_dir)
+    try:
+        import ppl as ppl_mod
+    finally:
+        sys.path.remove(bench_dir)
+
+    ids = (np.asarray(ppl_mod.builtin_tokens(None, n_tokens=768), np.int64)
+           % cfg.vocab_size).astype(np.int32)
+    p4 = requantize_params(params, "sym_int4")
+    p_bf16 = ppl_mod.sliding_ppl(cfg, params, ids, seq_len=256, stride=128)
+    p_int4 = ppl_mod.sliding_ppl(cfg, p4, ids, seq_len=256, stride=128)
+    ratio = p_int4 / p_bf16
+    assert ratio < 1.25, (p_bf16, p_int4)
