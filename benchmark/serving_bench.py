@@ -1230,9 +1230,60 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
                               if r.finish_reason in ("error", "timeout")),
                 "hangs": hangs[0],
             })
+            # the flight recorder is the chaos gate's postmortem
+            # artifact: quarantine/_fail_all freeze it automatically,
+            # and a failing gate ships the evidence in its own row
+            fl = eng.flight.view()
+            row["flight_dumps"] = len(fl["dumps"])
+            if row["failed"] or row["engine_errors"] or row["hangs"]:
+                row["flight_dump_reasons"] = [d["reason"]
+                                              for d in fl["dumps"]]
+                row["flight"] = fl["ring"][-16:]
         return row
     finally:
         eng.stop()
+
+
+def bench_observe(cfg, params, engine_config, concurrency: int = 4,
+                  n_reqs: int = 8, n_out: int = 16,
+                  prompt_lens=(24, 48, 72, 96), gap_s: float = 0.05,
+                  reps: int = 3) -> dict:
+    """The observability price row (BENCH_r13+): the SAME churn workload
+    with request-lifecycle tracing OFF (the default engine — tracer is
+    None, every trace site one attribute check) vs ON (spans staged in
+    the transactional tick), median-of-``reps`` each.  The flight
+    recorder and latency histograms are always on in BOTH rows, so the
+    traced row prices exactly the span machinery.  Gate expectation:
+    ``overhead_pct`` < 3 on agg tok/s (the ISSUE 13 acceptance bound) —
+    a regression here means a trace site leaked host work into the tick.
+    """
+    from dataclasses import replace as _dc_replace
+
+    rows = {}
+    for traced in (False, True):
+        runs = [bench_churn(cfg, params,
+                            _dc_replace(engine_config,
+                                        trace_requests=traced),
+                            concurrency=concurrency, n_reqs=n_reqs,
+                            n_out=n_out, prompt_lens=prompt_lens,
+                            gap_s=gap_s, seed=3 + rep)
+                for rep in range(reps)]
+        runs.sort(key=lambda r: r["agg_tok_s"])
+        rows[traced] = runs[len(runs) // 2]
+    plain, traced = rows[False], rows[True]
+    base = plain["agg_tok_s"]
+    return {
+        "workload": "observe",
+        "concurrency": concurrency,
+        "n_reqs": n_reqs,
+        "n_out": n_out,
+        "agg_tok_s_plain": base,
+        "agg_tok_s_traced": traced["agg_tok_s"],
+        "ttft_p95_s_plain": plain["ttft_p95_s"],
+        "ttft_p95_s_traced": traced["ttft_p95_s"],
+        "overhead_pct": (round(100.0 * (base - traced["agg_tok_s"])
+                               / base, 2) if base else 0.0),
+    }
 
 
 def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
@@ -1342,6 +1393,18 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
         except Exception as e:  # noqa: BLE001
             print(f"serving_bench skip churn budget={budget}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+    # observability price row (BENCH_r13+): the churn workload traced vs
+    # untraced — the tracing-enabled engine must stay within ~3% agg
+    # tok/s of the plain one (flight recorder + histograms are on in
+    # both rows; the delta prices exactly the per-request span staging)
+    try:
+        out.append(bench_observe(cfg, params, churn_ec, concurrency=c,
+                                 n_reqs=churn_reqs, n_out=churn_out,
+                                 prompt_lens=lens, gap_s=churn_gap,
+                                 reps=reps))
+    except Exception as e:  # noqa: BLE001
+        print(f"serving_bench skip observe: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     # fixed-byte-budget KV-storage sweep (bf16 vs fp8) at the ladder's top
     # concurrency: the pool budget is sized to JUST fit one wave of bf16
     # requests, so the bf16 row shows the pressure symptoms (prefix

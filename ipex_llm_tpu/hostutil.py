@@ -103,6 +103,13 @@ class HostLRU:
         self.misses += 1
         return None
 
+    def peek(self, key):
+        """Value for ``key`` with NO side effects at all: no LRU touch,
+        no hit/miss accounting — the read a pure observer (an export
+        path, a stats probe) takes so it cannot perturb eviction order
+        or the economics counters it is reporting on."""
+        return self._entries.get(key)
+
     def put(self, key, value, nbytes: int):
         """Insert/replace ``key`` (becomes most-recent), evicting LRU
         entries until it fits under the byte budget."""

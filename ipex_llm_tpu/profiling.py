@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -32,6 +33,32 @@ def trace(log_dir: str | None = None):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+_capture_lock = threading.Lock()
+
+
+def capture(log_dir: str, seconds: float) -> dict:
+    """Operational jax.profiler capture: trace THIS process for
+    ``seconds`` into ``log_dir`` (view with tensorboard/xprof/Perfetto).
+
+    The blocking body behind the serving tier's ``/debug/profile``
+    endpoint (run it in an executor): reuses :func:`trace`, serializes
+    concurrent captures (jax.profiler allows one at a time — a second
+    caller gets a clean error instead of a runtime crash), and returns
+    the artifact location.
+    """
+    seconds = max(0.1, min(float(seconds), 120.0))
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("a profiler capture is already running")
+    try:
+        t0 = time.perf_counter()
+        with trace(log_dir):
+            time.sleep(seconds)
+        return {"log_dir": log_dir,
+                "seconds": round(time.perf_counter() - t0, 3)}
+    finally:
+        _capture_lock.release()
 
 
 @dataclass

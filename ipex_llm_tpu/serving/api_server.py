@@ -27,6 +27,7 @@ from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
                                          ServingEngine, next_stream_item)
 from ipex_llm_tpu.serving.faults import EngineOverloaded
 from ipex_llm_tpu.serving.kv_transport import TransportError
+from ipex_llm_tpu.serving.observe import Tracer, parse_traceparent
 
 
 def _now() -> int:
@@ -45,13 +46,25 @@ def _req_failed(req: Request) -> bool:
 
 class OpenAIServer:
     def __init__(self, engine: ServingEngine, tokenizer, model_name: str,
-                 asr=None, drain_timeout_s: float = 30.0):
+                 asr=None, drain_timeout_s: float = 30.0,
+                 kv_import_token: str | None = None,
+                 profile_dir: str | None = None):
         if web is None:  # pragma: no cover
             raise ImportError(f"aiohttp is required for serving: {_AIOHTTP_ERR}")
         self.engine = engine
         self.tok = tokenizer
         self.model_name = model_name
         self.drain_timeout_s = drain_timeout_s
+        # shared-token authn for /kv/import (X-KV-Import-Token): the wire
+        # format's checksum proves INTEGRITY, not identity — without a
+        # token any caller that can reach the port can scatter
+        # checksum-consistent garbage into the shared prefix cache and
+        # poison every future prefix hit.  None = open (single-tenant /
+        # loopback deployments).
+        self.kv_import_token = kv_import_token
+        # /debug/profile capture target (a fresh temp dir per capture
+        # when None)
+        self.profile_dir = profile_dir
         # replica identity for the router tier: a stable uuid for this
         # server's lifetime (a restart mints a new one — that is the
         # point: the router can tell "same process recovered" from
@@ -81,6 +94,13 @@ class OpenAIServer:
         # router's handoff orchestration drives these two legs
         self.app.router.add_post("/kv/prefill", self.kv_prefill)
         self.app.router.add_post("/kv/import", self.kv_import)
+        # observability surface (serving/observe.py): per-request traces
+        # (assembled fleet-wide by the router), the tick flight recorder,
+        # and an operational jax.profiler capture window
+        self.app.router.add_get("/trace/{trace_id}", self.trace_get)
+        self.app.router.add_get("/debug/traces", self.traces_export)
+        self.app.router.add_get("/debug/flight", self.debug_flight)
+        self.app.router.add_get("/debug/profile", self.debug_profile)
         if asr is not None:
             self.app.router.add_post("/v1/audio/transcriptions",
                                      self.transcriptions)
@@ -109,10 +129,19 @@ class OpenAIServer:
         text += "\nassistant:"
         return list(self.tok(text)["input_ids"])
 
-    def _mk_request(self, body: dict, prompt_ids: list[int]) -> Request:
+    def _mk_request(self, body: dict, prompt_ids: list[int],
+                    headers=None) -> Request:
         def num(key, default, cast):
             v = body.get(key)
             return cast(default if v is None else v)
+
+        # W3C trace context: the real HTTP header wins (curl/OTel
+        # clients), the body field is the router's transport-agnostic
+        # carrier (HTTPBackend promotes it to the header; scripted
+        # backends deliver it in-body) — either way the engine's spans
+        # key to the caller's trace id and /trace assembles end to end
+        tp = parse_traceparent((headers or {}).get("traceparent")
+                               or body.get("traceparent"))
 
         eos: tuple[int, ...] = ()
         if self.tok.eos_token_id is not None:
@@ -134,6 +163,7 @@ class OpenAIServer:
             # so a deadline spans attempts instead of resetting per replica
             deadline_s=(float(body["deadline_s"])
                         if body.get("deadline_s") else None),
+            trace_id=tp[0] if tp else None,
         )
         stop = body.get("stop")
         req.stop_strings = ([stop] if isinstance(stop, str) else stop) or []
@@ -308,7 +338,8 @@ class OpenAIServer:
             # constrained decoding runs the offline validator-filtered path
             # (structured.py), bypassing the batch engine
             return await self._chat_json(body, ids)
-        req = self._submit(self._mk_request(body, ids))
+        req = self._submit(self._mk_request(body, ids,
+                                            request.headers))
         rid = f"chatcmpl-{req.request_id[:12]}"
 
         if body.get("stream"):
@@ -374,7 +405,8 @@ class OpenAIServer:
         if isinstance(prompt, list):
             prompt = prompt[0]
         ids = list(self.tok(prompt)["input_ids"])
-        req = self._submit(self._mk_request(body, ids))
+        req = self._submit(self._mk_request(body, ids,
+                                            request.headers))
         rid = f"cmpl-{req.request_id[:12]}"
 
         if body.get("stream"):
@@ -513,16 +545,24 @@ class OpenAIServer:
     async def metrics(self, request):
         """Prometheus-style text exposition, every series labelled with
         this replica's stable id so a fleet scrape stays per-replica
-        attributable; ``?format=json`` keeps the machine-readable shape
-        the router's aggregation fetches."""
+        attributable, now including REAL histogram series
+        (``_bucket``/``_sum``/``_count`` — TTFT, per-token latency, tick
+        sync, swap-in); ``?format=json`` keeps the machine-readable shape
+        the router's aggregation fetches and fleet-sums."""
         vals = self._metrics_numeric()
+        hists = self.engine.histograms()
         if request.query.get("format") == "json":
             return web.json_response(
-                {"replica_id": self.replica_id, "metrics": vals})
+                {"replica_id": self.replica_id, "metrics": vals,
+                 "histograms": {k: h.to_dict() for k, h in hists.items()}})
         lines = []
         for name in sorted(vals):
             lines.append(f'ipex_llm_tpu_{name}'
                          f'{{replica_id="{self.replica_id}"}} {vals[name]}')
+        for name in sorted(hists):
+            lines.extend(hists[name].prometheus_lines(
+                f"ipex_llm_tpu_{name}",
+                labels=f'replica_id="{self.replica_id}"'))
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -588,7 +628,25 @@ class OpenAIServer:
         into this engine's pool and prefix cache, so the completion
         routed here next prefills only the uncovered tail.  Malformed
         blobs are 400 (``TransportError`` — unverified bytes are never
-        scattered)."""
+        scattered).  With ``--kv-import-token`` set, callers must
+        present the shared token (X-KV-Import-Token): the blob checksum
+        proves integrity, NOT identity — without authn any reachable
+        caller could scatter checksum-consistent pages into the shared
+        prefix cache and poison every future prefix hit."""
+        if self.kv_import_token is not None:
+            import hmac
+            presented = request.headers.get("X-KV-Import-Token")
+            # constant-time: a short-circuiting != leaks correct token
+            # prefixes through 401 latency — exactly the caller this
+            # check exists to keep out
+            if not hmac.compare_digest(presented or "",
+                                       self.kv_import_token):
+                return web.json_response(
+                    {"error": {"message": "missing or invalid "
+                                          "X-KV-Import-Token",
+                               "type": "authentication_error",
+                               "code": "kv_import_unauthorized"}},
+                    status=401)
         blob = await request.read()
         loop = asyncio.get_running_loop()
         try:
@@ -601,9 +659,100 @@ class OpenAIServer:
                            "code": "bad_page_set"}}, status=400)
         return web.json_response(res)
 
+    # -- observability (serving/observe.py) ---------------------------------
+
+    async def trace_get(self, request):
+        """One request's lifecycle trace (``?format=chrome`` renders the
+        Chrome trace-event shape).  404 when tracing is off or the trace
+        aged out of the bounded LRU; the router's /trace/{id} merges
+        this replica's spans with its own and the other replicas'."""
+        tid = request.match_info["trace_id"]
+        tr = self.engine.trace_view(tid)
+        if tr is None:
+            return web.json_response(
+                {"error": {"message": f"unknown trace {tid!r} (tracing "
+                                      "disabled, or aged out)",
+                           "type": "invalid_request_error",
+                           "code": "unknown_trace"}}, status=404)
+        if request.query.get("format") == "chrome":
+            return web.json_response(Tracer.chrome_events([tr]))
+        return web.json_response(tr)
+
+    async def traces_export(self, request):
+        """Whole-window trace export: every trace still in the LRU, as
+        ids (default) or one Perfetto-loadable Chrome trace-event JSON
+        (``?format=chrome``) — the grab-everything artifact for a latency
+        investigation."""
+        tracer = self.engine.tracer
+        if tracer is None:
+            return web.json_response(
+                {"error": {"message": "tracing disabled (--trace / "
+                                      "EngineConfig.trace_requests)",
+                           "type": "invalid_request_error",
+                           "code": "tracing_disabled"}}, status=404)
+        if request.query.get("format") == "chrome":
+            return web.json_response(tracer.export_chrome())
+        return web.json_response({"trace_ids": tracer.trace_ids()})
+
+    async def debug_flight(self, request):
+        """The tick flight recorder: the last N working-tick records and
+        any frozen postmortem dumps (_fail_all / quarantine capture one
+        automatically) — what the SIGKILL and chaos gates previously had
+        no artifact for."""
+        return web.json_response(self.engine.flight.view())
+
+    async def debug_profile(self, request):
+        """Operational jax.profiler capture: trace this replica for
+        ``?seconds=N`` (clamped; default 3) into ``?dir=`` (restricted
+        to a subdirectory of --profile-dir — an unauthenticated caller
+        must not get an arbitrary-filesystem-write primitive out of
+        profiler artifacts) / ``--profile-dir`` / a fresh temp dir, via
+        profiling.trace — xprof/tensorboard/Perfetto-loadable.  409 when
+        a capture is already running (jax allows one at a time)."""
+        import os
+        import tempfile
+
+        from ipex_llm_tpu import profiling
+
+        try:
+            seconds = float(request.query.get("seconds", 3.0))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "seconds must be a number",
+                           "type": "invalid_request_error",
+                           "code": "bad_seconds"}}, status=400)
+        base = self.profile_dir
+        log_dir = base or tempfile.mkdtemp(prefix="ipex-llm-tpu-profile-")
+        sub = request.query.get("dir")
+        if sub:
+            if base is None:
+                return web.json_response(
+                    {"error": {"message": "?dir= requires --profile-dir "
+                                          "(captures are confined to it)",
+                               "type": "invalid_request_error",
+                               "code": "no_profile_dir"}}, status=400)
+            cand = os.path.realpath(os.path.join(base, sub))
+            if cand != os.path.realpath(base) and not cand.startswith(
+                    os.path.realpath(base) + os.sep):
+                return web.json_response(
+                    {"error": {"message": "?dir= must stay inside "
+                                          "--profile-dir",
+                               "type": "invalid_request_error",
+                               "code": "bad_profile_dir"}}, status=400)
+            log_dir = cand
+        loop = asyncio.get_running_loop()
+        try:
+            res = await loop.run_in_executor(
+                None, profiling.capture, log_dir, seconds)
+        except RuntimeError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "conflict_error",
+                           "code": "capture_in_progress"}}, status=409)
+        return web.json_response(res)
+
     # -- TGI protocol -------------------------------------------------------
 
-    def _tgi_request(self, body: dict) -> Request:
+    def _tgi_request(self, body: dict, headers=None) -> Request:
         """TGI shape: {"inputs": str, "parameters": {...}} (reference
         tgi_api_protocol.py ChatCompletionParam)."""
         p = body.get("parameters") or {}
@@ -617,8 +766,10 @@ class OpenAIServer:
             "seed": p.get("seed"),
             "deadline_s": body.get("deadline_s"),
         }
+        if body.get("traceparent"):
+            mapped["traceparent"] = body["traceparent"]
         ids = list(self.tok(body.get("inputs", ""))["input_ids"])
-        return self._mk_request(mapped, ids)
+        return self._mk_request(mapped, ids, headers)
 
     @staticmethod
     def _tgi_reason(fr: str | None) -> str:
@@ -639,7 +790,7 @@ class OpenAIServer:
 
     async def tgi_generate(self, request):
         body = await request.json()
-        req = self._submit(self._tgi_request(body))
+        req = self._submit(self._tgi_request(body, request.headers))
         text = await self._collect(req)
         if _req_failed(req):
             status = {"timeout": 408,
@@ -657,7 +808,7 @@ class OpenAIServer:
 
     async def tgi_generate_stream(self, request):
         body = await request.json()
-        req = self._submit(self._tgi_request(body))
+        req = self._submit(self._tgi_request(body, request.headers))
 
         def chunk(piece, finish, tok):
             n = len(req.output_ids)
@@ -751,7 +902,9 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
                  model=None, tokenizer=None,
                  asr_model_path: str | None = None,
                  tensor_parallel_size: int = 1,
-                 drain_timeout_s: float = 30.0) -> OpenAIServer:
+                 drain_timeout_s: float = 30.0,
+                 kv_import_token: str | None = None,
+                 profile_dir: str | None = None) -> OpenAIServer:
     """``tensor_parallel_size`` > 1 serves under a tp mesh (SPMD AutoTP, the
     reference's vLLM-TP serving mode); a model already ``.shard(mesh)``-ed
     passes its mesh through implicitly.
@@ -820,7 +973,9 @@ def build_server(model_path: str, low_bit: str = "sym_int4",
             AutoTokenizer.from_pretrained(asr_model_path),
         )
     return OpenAIServer(engine, tokenizer, model_name=model_path, asr=asr,
-                        drain_timeout_s=drain_timeout_s)
+                        drain_timeout_s=drain_timeout_s,
+                        kv_import_token=kv_import_token,
+                        profile_dir=profile_dir)
 
 
 def main(argv=None):
@@ -906,6 +1061,25 @@ def main(argv=None):
                     help="graceful-drain window on SIGTERM: stop admission "
                          "(503), let in-flight requests finish, then "
                          "abort stragglers")
+    ap.add_argument("--trace", action="store_true",
+                    help="request-lifecycle tracing: per-request spans "
+                         "(queue wait, prefill chunks, swap-ins, first "
+                         "token, decode horizons, spec rounds, retries, "
+                         "finish) staged inside the transactional tick, "
+                         "served at /trace/{id} and /debug/traces "
+                         "(Chrome trace-event JSON via ?format=chrome); "
+                         "honors/propagates W3C traceparent")
+    ap.add_argument("--kv-import-token", default=None, metavar="TOKEN",
+                    help="require this shared token (X-KV-Import-Token "
+                         "header) on /kv/import: blob checksums prove "
+                         "integrity, not identity — without a token the "
+                         "shared prefix cache is poisonable by any "
+                         "reachable caller.  The router forwards its "
+                         "--kv-import-token on handoff legs")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="jax.profiler capture target for /debug/profile"
+                         "?seconds=N (default: a fresh temp dir per "
+                         "capture)")
     args = ap.parse_args(argv)
     srv = build_server(
         args.model, args.low_bit,
@@ -919,10 +1093,13 @@ def main(argv=None):
                      weight_qtype=args.weight_qtype,
                      max_queue=args.max_queue,
                      request_deadline_s=args.request_deadline,
-                     max_step_retries=args.max_step_retries),
+                     max_step_retries=args.max_step_retries,
+                     trace_requests=args.trace),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
         drain_timeout_s=args.drain_timeout,
+        kv_import_token=args.kv_import_token,
+        profile_dir=args.profile_dir,
     )
     web.run_app(srv.app, host=args.host, port=args.port)
 

@@ -46,6 +46,9 @@ from ipex_llm_tpu.models.config import ModelConfig
 from ipex_llm_tpu.models.decoder import decoder_forward
 from ipex_llm_tpu.serving.faults import (EngineOverloaded, FaultInjector,
                                          is_transient)
+from ipex_llm_tpu.serving.observe import (FAST_LATENCY_BUCKETS_S,
+                                          LATENCY_BUCKETS_S, FlightRecorder,
+                                          Histogram, Tracer, span)
 
 NEG_INF = -1e30
 
@@ -187,6 +190,22 @@ class EngineConfig:
     # request finishes "timeout" without ever occupying a row) and at
     # every emission epoch.  0 = no deadline.
     request_deadline_s: float = 0.0
+    # request-lifecycle tracing (serving/observe.py): when True the
+    # engine records per-request spans — queue wait, swap-ins, prefill
+    # chunks, first token, every decode horizon, spec rounds with accept
+    # counts, retries, quarantine, finish — staged inside the
+    # transactional tick and flushed only on commit (a rolled-back tick
+    # never leaks a span), retrievable per request via /trace/{id} and
+    # exportable as Chrome trace-event JSON.  All timestamps are host
+    # clock reads at points the tick already visits: no new device
+    # syncs, JP106's one-dispatch tick untouched.  False = the tracer is
+    # None and every trace site is one `is None` check (bench_observe
+    # prices both).  The tick flight recorder and the latency histograms
+    # are always on — they are a dict append per working tick and a few
+    # float ops per token.
+    trace_requests: bool = False
+    trace_buffer: int = 256     # traces retained (LRU); spans/trace capped
+    flight_ring: int = 256      # tick records the flight recorder retains
 
     @property
     def n_pages(self) -> int:
@@ -263,6 +282,14 @@ class Request:
     # expired request finishes with finish_reason="timeout" — at admission
     # without ever occupying a row, or mid-generation at the next tick.
     deadline_s: float | None = None
+    # lifecycle-trace identity: the W3C traceparent trace id the HTTP
+    # surfaces parse from the router/client (None = the engine keys the
+    # trace on request_id), so one trace assembles across processes
+    trace_id: str | None = None
+    # last emission wall time (token-latency histogram bookkeeping;
+    # checkpointed with the tick so a rolled-back emission never skews
+    # the inter-token distribution)
+    _last_tok_s: float = 0.0
 
     def abort(self):
         self.cancelled = True
@@ -1239,6 +1266,9 @@ class ServingEngine:
         self._masked: set[str] = set()
         self._tick_arrivals: list[Request] = []
         self._retries = 0
+        # device dispatches issued by the current tick (flight-recorder
+        # bookkeeping; the JP106 audit pins the fused tick's at 1)
+        self._tick_dispatches = 0
         self._draining = False
         self._drain_deadline: float | None = None
         self._drain_abort = threading.Event()
@@ -1249,6 +1279,46 @@ class ServingEngine:
         # that host-side state diverged from the device copies.
         self._dev: dict[str, jnp.ndarray] | None = None
         self._dirty = True
+        # request-lifecycle tracing (observe.py): None unless the config
+        # enables it — every trace site below guards on that None, so the
+        # disabled engine pays one attribute check per site.  Spans stage
+        # in _span_staging during a transactional tick and flush only on
+        # _commit (the _queue_put discipline applied to telemetry).
+        self.tracer = (Tracer(self.ec.trace_buffer)
+                       if self.ec.trace_requests else None)
+        self._span_staging: list[tuple[str, dict]] = []
+        # tick flight recorder: always on (one small dict per committed
+        # working tick); _fail_all and quarantine freeze it automatically
+        self.flight = FlightRecorder(self.ec.flight_ring)
+        # recovery-evidence baselines: retries and injector hits land
+        # BETWEEN records (the failed tick rolls back and never records,
+        # _recover bumps afterwards, and the next checkpoint absorbs the
+        # bump into its m0) — so per-record deltas key off the last
+        # RECORDED tick, not the per-tick checkpoint, or the ring would
+        # show retries=0 and no fault_sites for exactly the faults it
+        # exists to explain
+        self._flight_retries0 = 0
+        self._flight_hits0: dict = {}
+        # honest latency histograms (fixed Prometheus buckets, fleet-
+        # summable, checkpoint/rollback-safe): TTFT, client-visible
+        # inter-token latency (bursty by design under a fused horizon —
+        # the distribution SHOWS the H-token delivery granularity),
+        # blocking tick-sync time, and swap-in measured through the
+        # completion barrier (the vacuous enqueue-only p95 fix)
+        self.hists: dict[str, Histogram] = {
+            "ttft_s": Histogram(LATENCY_BUCKETS_S),
+            "token_latency_s": Histogram(LATENCY_BUCKETS_S),
+            "tick_sync_s": Histogram(FAST_LATENCY_BUCKETS_S),
+            "swap_in_s": Histogram(FAST_LATENCY_BUCKETS_S),
+        }
+        # the COMMITTED view /metrics serves: `self.hists` mutates
+        # mid-tick and reverts on rollback, so a scrape reading it live
+        # could observe counts a rollback then subtracts — a Prometheus
+        # counter going backwards reads as a reset and fabricates rates.
+        # _commit republishes this dict (atomic reference swap; the
+        # published Histograms are never mutated after publication).
+        self._hists_committed: dict[str, Histogram] = {
+            k: h.copy() for k, h in self.hists.items()}
         # rolling TTFT window for /health (what the admission-wave mixed
         # step is judged on)
         self._ttfts: "deque[float]" = deque(maxlen=128)
@@ -1443,6 +1513,114 @@ class ServingEngine:
             "tokens_per_dispatch": m.get("spec_tokens_per_dispatch", 0.0),
         }
 
+    # -- observability (serving/observe.py) ---------------------------------
+
+    def _trace(self, req: Request | None, name: str, t0: float | None = None,
+               t1: float | None = None, **attrs):
+        """Record one lifecycle span/event on ``req``'s trace.  Inside a
+        transactional tick the span STAGES (beside the token emissions)
+        and flushes only on ``_commit`` — a rolled-back tick never leaks
+        a span; outside a tick (recovery, quarantine) it lands directly.
+        One ``is None`` check when tracing is disabled."""
+        if self.tracer is None or req is None:
+            return
+        s = span(name, time.time() if t0 is None else t0, t1,
+                 origin="engine", **attrs)
+        tid = req.trace_id or req.request_id
+        if self._staging is not None:
+            self._span_staging.append((tid, s))
+        else:
+            self.tracer.add(tid, s)
+
+    def trace_view(self, trace_id: str) -> dict | None:
+        """Assembled span list for one trace (/trace/{id}); None when
+        tracing is disabled or the trace aged out of the LRU."""
+        if self.tracer is None:
+            return None
+        return self.tracer.get(trace_id)
+
+    def histograms(self) -> dict[str, Histogram]:
+        """The engine's latency histograms (real Prometheus
+        ``_bucket/_sum/_count`` series on /metrics; fleet-summed by the
+        router).  Returns the last COMMITTED view, not the live tick
+        state: mid-tick observations a rollback would subtract are never
+        scrape-visible, so the exposed series stay monotonic."""
+        return self._hists_committed
+
+    def _flight_pending(self) -> dict:
+        """Recovery evidence accumulated since the last RECORDED tick:
+        a failed tick rolls back and never records, and _recover bumps
+        its counters afterwards — so retries and injector site hits are
+        invisible to per-tick checkpoint deltas and must be carried
+        against the last-record baseline instead (the next committed
+        record absorbs them; dumps taken at the recovery decision carry
+        them immediately)."""
+        out = {"retries": self.metrics.get("retries", 0)
+               - self._flight_retries0}
+        if self.injector is not None:
+            hits = {k: v - self._flight_hits0.get(k, 0)
+                    for k, v in self.injector.site_hits.items()
+                    if v != self._flight_hits0.get(k, 0)}
+            if hits:
+                out["fault_sites"] = hits
+        return out
+
+    def _flight_record(self, m0: dict, snap: dict, t_wall: float):
+        """Append one committed tick's record to the flight recorder —
+        per-tick DELTAS against the pre-tick checkpoint (recovery
+        evidence against the last-record baseline; see
+        ``_flight_pending``), so the ring reads as what each tick did,
+        not cumulative counters.  Pure idle ticks are skipped (the idle
+        loop runs ~50 ticks/s; recording them would flush real work out
+        of the ring in seconds)."""
+        m = self.metrics
+
+        def d(key):
+            return m.get(key, 0) - m0.get(key, 0)
+
+        pend = self._flight_pending()
+        tokens, admitted = d("tokens"), d("requests")
+        working = (tokens or admitted or d("mixed_prefill_tokens")
+                   or pend["retries"] or pend.get("fault_sites")
+                   or d("errors_isolated") or d("timeouts")
+                   or self._tick_dispatches)
+        if not working:
+            self.flight.skip_idle()
+            return
+        pages_before = self.ec.n_pages - 1 - len(snap["alloc"][0])
+        rec = {
+            "t": round(t_wall, 3),
+            "tick": m.get("ticks", 0),
+            "dispatches": self._tick_dispatches,
+            "sync_s": round(m.get("host_sync_s", 0.0)
+                            - m0.get("host_sync_s", 0.0), 6),
+            "rows_active": int(sum(1 for i, r in enumerate(self.rows)
+                                   if r is not None
+                                   and i not in self._prefilling)),
+            "rows_prefilling": len(self._prefilling),
+            "queue_depth": m.get("queue_depth", 0),
+            "tokens": tokens,
+            "admitted": admitted,
+            "pages_in_use": self.alloc.pages_in_use,
+            "pages_delta": self.alloc.pages_in_use - pages_before,
+            "prefix_evictions": self.alloc.prefix_evictions
+            - snap["alloc"][4],
+            "alloc_fail_clamps": d("alloc_fail_clamps"),
+            "retries": pend["retries"],
+        }
+        if self.pagestore is not None and snap["pagestore"] is not None:
+            rec["pages_spilled"] = (self.pagestore.spills
+                                    - snap["pagestore"]["spills"])
+            rec["swap_ins"] = (self.pagestore.swap_ins
+                               - snap["pagestore"]["swap_ins"])
+        if pend.get("fault_sites"):
+            rec["fault_sites"] = pend["fault_sites"]
+        # consumed: the next record's recovery deltas start here
+        self._flight_retries0 = m.get("retries", 0)
+        if self.injector is not None:
+            self._flight_hits0 = dict(self.injector.site_hits)
+        self.flight.record(rec)
+
     @property
     def draining(self) -> bool:
         return self._draining
@@ -1555,8 +1733,13 @@ class ServingEngine:
             # rolled-back tick leaves the store residue-free
             "pagestore": (self.pagestore.snapshot()
                           if self.pagestore is not None else None),
+            # the latency histograms revert with the tick (PR 5's counter
+            # rule): a rolled-back tick's TTFT/token-latency observations
+            # were never client-visible — O(buckets) per histogram
+            "hists": {k: h.snapshot() for k, h in self.hists.items()},
             "reqs": [(r, len(r.output_ids), len(r.logprobs),
-                      r.finish_reason, r.first_token_s) for r in reqs],
+                      r.finish_reason, r.first_token_s, r._last_tok_s)
+                     for r in reqs],
         }
 
     def _rollback(self, snap: dict):
@@ -1601,11 +1784,18 @@ class ServingEngine:
         m["rejected"] = max(self.metrics.get("rejected", 0),
                             m.get("rejected", 0))
         self.metrics = m
-        for r, n_out, n_lp, fin, fts in snap["reqs"]:
+        for k, h in self.hists.items():
+            h.restore(snap["hists"][k])
+        # staged spans discard with the tick: clients saw no tokens, the
+        # trace must show no spans (the retry/quarantine events recovery
+        # writes are post-rollback, so they survive by construction)
+        self._span_staging = []
+        for r, n_out, n_lp, fin, fts, lts in snap["reqs"]:
             del r.output_ids[n_out:]
             del r.logprobs[n_lp:]
             r.finish_reason = fin
             r.first_token_s = fts
+            r._last_tok_s = lts
         self._pending = deque(snap["pending"])
         for r in self._tick_arrivals:   # drained mid-tick: fresh again
             r.output_ids.clear()
@@ -1624,10 +1814,17 @@ class ServingEngine:
         """Flush the tick's staged emissions to the client queues, in
         emission order — the only point tokens become externally visible."""
         staged, self._staging = self._staging, None
+        staged_spans, self._span_staging = self._span_staging, []
         self._tick_arrivals = []
         for q, item in staged:
             q.put(item)
+        if self.tracer is not None:
+            for tid, s in staged_spans:
+                self.tracer.add(tid, s)
         self.metrics["queue_depth"] = self.queue_depth
+        # republish the scrape-visible histogram view (O(buckets), same
+        # cost class as the per-tick checkpoint snapshots)
+        self._hists_committed = {k: h.copy() for k, h in self.hists.items()}
 
     def _tick(self):
         """ONE transactional engine tick: checkpoint, run the step,
@@ -1640,7 +1837,10 @@ class ServingEngine:
             self._drain_abort.clear()
         snap = self._checkpoint()
         self._staging = []
+        self._span_staging = []
         self._tick_arrivals = []
+        self._tick_dispatches = 0
+        t_wall = time.time()
         try:
             self._step_once()
         except Exception as exc:
@@ -1652,6 +1852,7 @@ class ServingEngine:
         # post-commit on purpose: a rolled-back tick never advances the
         # liveness counter, so `ticks` moves iff the engine makes progress
         self.metrics["ticks"] = self.metrics.get("ticks", 0) + 1
+        self._flight_record(snap["metrics"], snap, t_wall)
         return True
 
     def _recover(self, exc: BaseException):
@@ -1665,6 +1866,13 @@ class ServingEngine:
         if is_transient(exc) and self._retries < self.ec.max_step_retries:
             self._retries += 1
             self.metrics["retries"] = self.metrics.get("retries", 0) + 1
+            if self.tracer is not None:
+                # post-rollback, so these land directly: the trace shows
+                # the retry/rollback the client never saw tokens from
+                for req in [r for r in self.rows if r is not None] + \
+                        list(self._pending):
+                    self._trace(req, "retry", attempt=self._retries,
+                                error=f"{type(exc).__name__}: {exc}")
             self._stop.wait(
                 self.ec.retry_backoff_s * (2 ** (self._retries - 1)))
             return
@@ -1687,6 +1895,7 @@ class ServingEngine:
         observe whether the fault fires, they never commit."""
         snap = self._checkpoint()
         self._staging = []
+        self._span_staging = []     # probes mute spans like emissions
         self._tick_arrivals = []
         self._masked = set(masked_ids)
         self._dirty = True   # the active mask changed vs the device copy
@@ -1739,6 +1948,18 @@ class ServingEngine:
         self.metrics["last_error"] = (
             f"isolated to request {req.request_id[:12]}: "
             f"{type(exc).__name__}: {exc}")
+        # the postmortem artifact, captured at the blast-radius decision:
+        # the flight ring shows what the last N working ticks did leading
+        # up to this isolation
+        self.flight.dump("quarantine", request_id=req.request_id,
+                         error=f"{type(exc).__name__}: {exc}",
+                         # the failed ticks leading here rolled back and
+                         # never recorded — their retries/injector hits
+                         # ride the dump itself
+                         **{f"{k}_pending": v for k, v
+                            in self._flight_pending().items() if v})
+        self._trace(req, "quarantine",
+                    error=f"{type(exc).__name__}: {exc}")
         for i, r in enumerate(self.rows):
             if r is req:
                 self._finish(i, "error")
@@ -1862,33 +2083,64 @@ class ServingEngine:
                                  np.ascontiguousarray(k_np[:, i]),
                                  np.ascontiguousarray(v_np[:, i]))
 
-    def _swap_in(self, key: bytes) -> int | None:
-        """Promote a spilled page back into the pool: allocate a slot
-        (which may itself demote colder pages), scatter the stored bytes
-        through the h2d boundary, and register the page cache-owned —
-        byte-identical to one that never left the pool.  Returns the pid
-        (the admission loop addrefs it exactly like a prefix hit) or
-        None on a store miss / dry pool."""
-        entry = self.pagestore.take(key)
-        if entry is None:
-            return None
+    def _swap_in_chain(self, entries: list, req: Request | None = None
+                       ) -> dict:
+        """Promote a chain of spilled pages back into the pool in ONE
+        batch: ``reserve()`` pre-evicts for the whole burst, allocation
+        stops at the first dry pid (chain order — what fits is the
+        unbroken head; the rest hand their entries back via
+        ``untake``), one stacked scatter lands every accepted page, and
+        ONE completion barrier covers the batch — per-page barriers
+        serialized N full device round-trips on exactly the spill-heavy
+        admission path the swap-in histogram monitors.  ``entries`` is
+        ``[(key, (k_np, v_np)), ...]``; returns {key: pid} for the
+        promoted head, each page registered cache-owned at ref 1 —
+        byte-identical to one that never left the pool."""
+        if not entries:
+            return {}
         self._fault_point("swap-in")
-        pid = self.alloc.alloc()
-        if pid is None:
+        self.alloc.reserve(len(entries))
+        pids: list[int] = []
+        for _ in entries:
+            pid = self.alloc.alloc()
+            if pid is None:
+                break                       # dry pool: keep what fit
+            pids.append(pid)
+        taken = entries[:len(pids)]
+        for key, entry in entries[len(pids):]:
             self.pagestore.untake(key, entry)   # failed promotion
-            return None
+        if not taken:
+            return {}
         t0 = time.perf_counter()
-        k_np, v_np = entry
+        t0_w = time.time()
+        k_stack = np.stack([e[0] for _, e in taken], axis=1)
+        v_stack = np.stack([e[1] for _, e in taken], axis=1)
         self.cache = self.cache.scatter_pages(
-            np.asarray([pid], np.int32), h2d(k_np[:, None]),
-            h2d(v_np[:, None]))
-        self.pagestore.record_swap_in(time.perf_counter() - t0)
-        # transfer alloc()'s caller reference to the prefix cache
-        # (register_prefix addrefs, so drop ours): the page ends
-        # cache-owned at ref 1 — exactly a registered page no row holds
-        self.alloc.register_prefix(key, pid)
-        self.alloc.decref(pid)
-        return pid
+            np.asarray(pids, np.int32), h2d(k_stack), h2d(v_stack))
+        # completion barrier: swap-in latency must cover the scatter
+        # REACHING the pool, not just its enqueue — on an async backend
+        # the enqueue-only figure was vacuous (microseconds regardless of
+        # page size), and the admission that depends on these pages blocks
+        # on exactly this work anyway.  Epoch-boundary sync, not tick
+        # work (JP106 untouched).
+        # jaxlint: disable=JL002 -- designed epoch-boundary completion barrier: the swap-in p95 /health reports must measure transfer completion, not dispatch enqueue (the PR 11 vacuous-timing fix)
+        self.cache.k.block_until_ready()
+        self.cache.v.block_until_ready()  # jaxlint: disable=JL002 -- rides the same designed swap-in barrier; k already blocked above
+        seconds = time.perf_counter() - t0
+        self.pagestore.record_swap_in(seconds, pages=len(taken))
+        self.hists["swap_in_s"].observe(seconds)
+        self._trace(req, "swap_in", t0=t0_w, t1=time.time(),
+                    seconds=round(seconds, 6), pages=len(taken))
+        out = {}
+        for (key, _), pid in zip(taken, pids):
+            # transfer alloc()'s caller reference to the prefix cache
+            # (register_prefix addrefs, so drop ours): the page ends
+            # cache-owned at ref 1 — exactly a registered page no row
+            # holds
+            self.alloc.register_prefix(key, pid)
+            self.alloc.decref(pid)
+            out[key] = pid
+        return out
 
     def _spill_finished_row(self, row: int, req: Request):
         """Cold-row spill at finish: a cleanly-finished row's decode
@@ -2021,20 +2273,33 @@ class ServingEngine:
         kv_transport.check_pool_shape(meta, **self._pool_shape())
         self._fault_point("kv-import")
         t0 = time.perf_counter()
-        imported = skipped = 0
-        for key, k_page, v_page in pages:
-            if key in self.alloc.prefix:
-                skipped += 1
-                continue
+        # batched import: reserve() pre-evicts for the whole burst (one
+        # spill gather instead of one per page — the PageAllocator's
+        # allocation-burst contract), allocation stops at the first dry
+        # pid (chain order: what fits is the unbroken head), and ONE
+        # scatter lands every accepted page — the per-page
+        # allocate/scatter loop cost len(pages) dispatches and len(pages)
+        # h2d uploads for a blob that arrives as one contiguous set
+        fresh = [(key, k_page, v_page) for key, k_page, v_page in pages
+                 if key not in self.alloc.prefix]
+        skipped = len(pages) - len(fresh)
+        self.alloc.reserve(len(fresh))
+        pids: list[int] = []
+        for _ in fresh:
             pid = self.alloc.alloc()
             if pid is None:
                 break                       # dry pool: keep what fit
+            pids.append(pid)
+        taken = fresh[:len(pids)]
+        if taken:
+            k_stack = np.stack([k for _, k, _ in taken], axis=1)
+            v_stack = np.stack([v for _, _, v in taken], axis=1)
             self.cache = self.cache.scatter_pages(
-                np.asarray([pid], np.int32),
-                h2d(k_page[:, None]), h2d(v_page[:, None]))
-            self.alloc.register_prefix(key, pid)
-            self.alloc.decref(pid)          # cache-owned at ref 1
-            imported += 1
+                np.asarray(pids, np.int32), h2d(k_stack), h2d(v_stack))
+            for (key, _, _), pid in zip(taken, pids):
+                self.alloc.register_prefix(key, pid)
+                self.alloc.decref(pid)      # cache-owned at ref 1
+        imported = len(taken)
         self.metrics["kv_pages_imported"] = (
             self.metrics.get("kv_pages_imported", 0) + imported)
         return {"imported_pages": imported, "skipped_pages": skipped,
@@ -2201,20 +2466,41 @@ class ServingEngine:
             # through the model to produce logits)
             keys = _chain_hashes(prompt, ps)
             shareable = min(len(keys), (n_p - 1) // ps)
-            shared = 0
+            # plan the chain: device prefix hits take their row ref
+            # immediately (protecting them from the batched promotion's
+            # evictions, exactly like the old sequential addref), store
+            # misses are take()n so the spill-tier promotion — a PCIe
+            # copy instead of re-prefilling the chunk — lands as ONE
+            # batched scatter + barrier for the whole chain
+            plan: list[tuple] = []      # ("dev", pid) | ("host", key, entry)
             for i in range(shareable):
                 pid = self.alloc.lookup_prefix(keys[i])
-                if pid is None and self.pagestore is not None:
-                    # spill-tier promotion: a page the pool evicted (or
-                    # a finished row's decode page) swaps back in — a
-                    # PCIe copy instead of re-prefilling the chunk
-                    pid = self._swap_in(keys[i])
-                if pid is None:
+                if pid is not None:
+                    self.alloc.addref(pid)
+                    plan.append(("dev", pid))
+                    continue
+                entry = (self.pagestore.take(keys[i])
+                         if self.pagestore is not None else None)
+                if entry is None:
                     break
-                self.alloc.addref(pid)
-                self.tables[row, i] = pid
+                plan.append(("host", keys[i], entry))
+            promoted = self._swap_in_chain(
+                [(e[1], e[2]) for e in plan if e[0] == "host"], req=req)
+            shared = 0
+            for e in plan:
+                if e[0] == "host":
+                    pid = promoted.get(e[1])
+                    if pid is None:
+                        break           # dry pool broke the chain here
+                    self.alloc.addref(pid)
+                else:
+                    pid = e[1]          # row ref taken in the plan walk
+                self.tables[row, shared] = pid
                 self._dirty_tables.add(row)
                 shared += 1
+            for e in plan[shared:]:
+                if e[0] == "dev":       # past the break: drop the row ref
+                    self.alloc.decref(e[1])
 
             base = shared * ps
             if not self._ensure_pages(row, n_p, req=req):
@@ -2240,6 +2526,14 @@ class ServingEngine:
                 # exactly the pool pressure the kv sweep measures)
                 self.metrics["prefix_hits"] += 1
                 self.metrics["prefix_pages_shared"] += shared
+            if self.tracer is not None:
+                # queue-wait span: submission wall time is reconstructed
+                # from the perf_counter stamp submit() recorded
+                now_w = time.time()
+                sub_w = now_w - (time.perf_counter() - req.submitted_s)
+                self._trace(req, "queue", t0=sub_w, t1=now_w,
+                            queue_depth=self.queue_depth,
+                            prompt_tokens=n_p, shared_pages=shared)
             self.rows[row] = req
             self.row_lens[row] = base
             self.row_budget[row] = req.max_new_tokens
@@ -2283,6 +2577,7 @@ class ServingEngine:
         toks = np.zeros((1, cp), np.int32)
         toks[0, :n_valid] = chunk
         self._fault_point("prefill-chunk", rows=(row,))
+        t0_w = time.time()
         # dirty-row table sync: only the rows whose tables changed since
         # the last device call are scattered in (this row's new pages),
         # not the whole [R, maxP] table per chunk
@@ -2293,7 +2588,10 @@ class ServingEngine:
             h2d(base, jnp.int32), h2d(n_valid, jnp.int32),
             mesh=self.mesh,
         )
+        self._tick_dispatches += 1
         self.row_lens[row] = base + n_valid
+        self._trace(req, "prefill_chunk", t0=t0_w, t1=time.time(),
+                    tokens=n_valid, base=base)
         self._dirty = True  # prefill epoch: row_lens advanced
         if n_valid < len(remaining):
             self._prefilling[row] = remaining[n_valid:]
@@ -2333,12 +2631,16 @@ class ServingEngine:
             return
         req.first_token_s = time.perf_counter() - req.submitted_s
         self._record_ttft(req.first_token_s)
+        self._trace(req, "first_token",
+                    ttft_s=round(req.first_token_s, 6))
         self.toks[row] = first
         self._emit(row, first, logprob)
 
     def _record_ttft(self, seconds: float):
-        """Rolling TTFT percentile for /health (128-request window)."""
+        """Rolling TTFT percentile for /health (128-request window) +
+        the fixed-bucket histogram /metrics exposes in Prometheus form."""
         self._ttfts.append(seconds)
+        self.hists["ttft_s"].observe(seconds)
         self.metrics["ttft_p95_s"] = round(
             float(np.percentile(np.fromiter(self._ttfts, np.float64), 95)),
             4)
@@ -2350,6 +2652,15 @@ class ServingEngine:
             return
         req.output_ids.append(token)
         req.logprobs.append(logprob)
+        # client-visible inter-token latency (first token measures TTFT
+        # in its own histogram): under a fused horizon this is honestly
+        # BURSTY — H tokens drain in one commit, so the distribution
+        # shows ~0 within a block and the tick interval between blocks,
+        # which is exactly the granularity a streaming client observes
+        now = time.perf_counter()
+        if req._last_tok_s:
+            self.hists["token_latency_s"].observe(now - req._last_tok_s)
+        req._last_tok_s = now
         self._queue_put(req, token)
         self.metrics["tokens"] += 1
         if token in req.eos_token_id:
@@ -2372,6 +2683,8 @@ class ServingEngine:
             # slots are recycled (aborts/errors spill nothing: their KV
             # may be incomplete)
             self._spill_finished_row(row, req)
+        self._trace(req, "finish", reason=req.finish_reason,
+                    output_tokens=len(req.output_ids))
         self._queue_put(req, None)
         self.rows[row] = None
         self.row_lens[row] = 0
@@ -2387,8 +2700,13 @@ class ServingEngine:
         recovery machinery itself failed): finish every in-flight/queued
         request so no client blocks forever, then keep serving."""
         self._staging = None    # emissions flush directly from here on
+        self._span_staging = []
         self._tick_arrivals = []
         self._masked = set()
+        self.flight.dump("fail_all",
+                         error=f"{type(exc).__name__}: {exc}",
+                         **{f"{k}_pending": v for k, v
+                            in self._flight_pending().items() if v})
         for i, req in enumerate(self.rows):
             if req is not None:
                 self._finish(i, "error")
@@ -2516,6 +2834,7 @@ class ServingEngine:
                 drafts[i, :k_req] = np.where(valid, d, 0)
         self._fault_point("decode-dispatch",
                           rows=[i for i in range(n_rows) if active[i]])
+        t0_w = time.time()
         cache = self._flush_dirty_tables()
         steps = np.asarray([
             len(r.output_ids) if r is not None else 0 for r in self.rows
@@ -2532,6 +2851,7 @@ class ServingEngine:
             h2d(self.seeds), h2d(steps),
             h2d(self.top_ks), k=k, mesh=self.mesh, **extra,
         )
+        self._tick_dispatches += 1
         t0 = time.perf_counter()
         # jaxlint: disable=JL002 -- designed sync: the verify round's accepted tokens must reach the host to walk acceptance chains; counted via _count_sync
         t_all, lp_all = d2h(t_all), d2h(lp_all)
@@ -2543,6 +2863,7 @@ class ServingEngine:
         for i in range(n_rows):
             if not active[i] or self.rows[i] is None:
                 continue
+            req_i = self.rows[i]
             emitted = [(int(t_all[i, 0]), float(lp_all[i, 0]))]
             for j in range(int(n_prop[i])):
                 # the draft fed at position j+1 must equal the token just
@@ -2562,6 +2883,9 @@ class ServingEngine:
                 self._emit(i, tok, lp)
                 if self.rows[i] is None:  # finished (eos/length/abort) mid-chain
                     break
+            self._trace(req_i, "spec_round", t0=t0_w, t1=time.time(),
+                        rounds=1, tokens=len(emitted),
+                        accepted=len(emitted) - 1)
         self.metrics["spec_steps"] = self.metrics.get("spec_steps", 0) + 1
         self.metrics["spec_emitted"] = (
             self.metrics.get("spec_emitted", 0) + emitted_total
@@ -2810,6 +3134,7 @@ class ServingEngine:
                         if emit[s] and canjoin[s]]
         if with_decode and decode_rows:
             self._fault_point("decode-dispatch", rows=decode_rows)
+        t0_w = time.time()
         cache = self._flush_dirty_tables()
         full_tables = cache.tables
         row_idx = np.zeros((p_b,), np.int32)
@@ -2853,6 +3178,7 @@ class ServingEngine:
                 hist=dev["hist"], spec_ks=h2d(spec_ks),
                 spec_k=self.ec.spec_k, spec_ngram=self.ec.spec_ngram,
                 mesh=self.mesh)
+            self._tick_dispatches += 1
         else:
             (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
              dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
@@ -2863,11 +3189,15 @@ class ServingEngine:
                 dev["top_ks"], dev["eos"], dev["remain"],
                 prefill=prefill, horizon=1,
                 with_decode=with_decode, mesh=self.mesh)
+            self._tick_dispatches += 1
         # advance bookkeeping; completed prompts run the shared
         # completion path (_finish_prompt) once their token arrives
         completing: list[tuple[int, int]] = []   # (slot, row)
         for i, row, n_i in chunks:
             self.row_lens[row] += n_i
+            self._trace(self.rows[row], "prefill_chunk", t0=t0_w,
+                        t1=time.time(), tokens=n_i,
+                        base=int(base[i]), fused=True)
             rem = self._prefilling[row]
             if n_i == len(rem):
                 self._prefilling.pop(row)
@@ -2910,13 +3240,16 @@ class ServingEngine:
         # the drain walk covers the decode participants: rows already
         # decoding plus completions that joined on device; rows finished
         # above (first-token EOS/budget/length) are None and skip
+        mask = self._active_mask()
+        parts = self._decode_parts(mask)
         if tick_spec:
             take_np = d2h(take_block)  # jaxlint: disable=JL002 -- rides THE per-tick sync: per-iteration accepted counts for the drain walk
             self._spec_metrics(take_np, s_prop, s_acc, executed)
-            self._drain_spec_block(tok_np, lp_np, take_np,
-                                   self._active_mask(), executed)
+            self._drain_spec_block(tok_np, lp_np, take_np, mask, executed)
         else:
-            self._drain_block(tok_np, lp_np, self._active_mask(), executed)
+            take_np = None
+            self._drain_block(tok_np, lp_np, mask, executed)
+        self._trace_decode(parts, t0_w, executed, take_np)
         self.metrics["tokens_per_sync"] = round(
             self.metrics["tokens"] / max(self.metrics["host_syncs"], 1), 2)
 
@@ -2982,6 +3315,7 @@ class ServingEngine:
         self._fault_point("decode-dispatch",
                           rows=[i for i in range(len(self.rows))
                                 if active[i]])
+        t0_w = time.time()
         dev = self._sync_device_state()
         if self._pp_mode:
             nxt, lp, self.cache, self.key = _pp_decode_sample(
@@ -2989,6 +3323,7 @@ class ServingEngine:
                 dev["row_lens"], dev["active"], dev["temps"], dev["top_ps"],
                 self.key, dev["seeds"], dev["steps"], dev["top_ks"],
                 mesh=self.mesh, n_micro=self.mesh.shape["pp"])  # jaxlint: disable=JL003 -- pp mesh shape is fixed for the engine lifetime: exactly one compiled program
+            self._tick_dispatches += 1
             tok_block, lp_block = nxt[:, None], lp[:, None]
             # the pp schedule stays H=1 for now (a horizon scan would nest
             # the GPipe fill/drain per step); it still routes through this
@@ -3010,6 +3345,7 @@ class ServingEngine:
                 prefill=None, horizon=h, hist=dev["hist"],
                 spec_ks=h2d(spec_ks), spec_k=self.ec.spec_k,
                 spec_ngram=self.ec.spec_ngram, mesh=self.mesh)
+            self._tick_dispatches += 1
         else:
             # the steady-state tick is the SAME single jitted entry the
             # mixed tick uses, with no prefill block: one program either
@@ -3025,6 +3361,7 @@ class ServingEngine:
                 dev["top_ps"], self.key, dev["seeds"], dev["steps"],
                 dev["top_ks"], dev["eos"], dev["remain"],
                 prefill=None, horizon=h, mesh=self.mesh)
+            self._tick_dispatches += 1
             # the returned cache owns the (donated) tables buffer now
         t0 = time.perf_counter()
         tok_block = d2h(tok_block)   # jaxlint: disable=JL002 -- THE per-horizon designed sync: h tokens per host round trip, counted via _count_sync
@@ -3036,15 +3373,53 @@ class ServingEngine:
         self.metrics["steps"] += executed
         self.metrics["decode_horizon_effective"] = h
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
+        parts = self._decode_parts(active)
         if self._fused_spec and not self._pp_mode:
             take_block = d2h(take_block)  # jaxlint: disable=JL002 -- rides THE per-horizon sync: per-iteration accepted counts for the drain walk
             self._spec_metrics(take_block, s_prop, s_acc, executed)
             self._drain_spec_block(tok_block, lp_block, take_block,
                                    active, executed)
+            take_np = take_block
         else:
+            take_np = None
             self._drain_block(tok_block, lp_block, active, executed)
+        self._trace_decode(parts, t0_w, executed, take_np)
         self.metrics["tokens_per_sync"] = round(
             self.metrics["tokens"] / self.metrics["host_syncs"], 2)
+
+    def _decode_parts(self, active: np.ndarray):
+        """Tracing pre-capture for a decode drain: the participating
+        (row, request, tokens-so-far) triples, so the per-request
+        decode-horizon span can report the tokens THIS tick emitted.
+        None when tracing is off (zero cost)."""
+        if self.tracer is None:
+            return None
+        return [(i, self.rows[i], len(self.rows[i].output_ids))
+                for i in range(len(self.rows))
+                if active[i] and self.rows[i] is not None]
+
+    def _trace_decode(self, parts, t0_w: float, executed: int, take_np):
+        """Per-request span for one committed decode tick: the fused
+        horizon (`decode_horizon`, steps + tokens) or the speculative
+        loop (`spec_round`, iterations + accept counts from the device's
+        take block).  Timestamps are the tick's own host window — the
+        existing one-per-tick sync, no new device reads."""
+        if not parts:
+            return
+        t1 = time.time()
+        for i, req, n0 in parts:
+            toks = len(req.output_ids) - n0
+            if toks == 0 and req.finish_reason is None:
+                continue            # masked out / spec-width-0 idle row
+            if take_np is not None:
+                row = take_np[i]
+                rounds = int((row > 0).sum())
+                self._trace(req, "spec_round", t0=t0_w, t1=t1,
+                            rounds=rounds, tokens=toks,
+                            accepted=max(int(row.sum()) - rounds, 0))
+            else:
+                self._trace(req, "decode_horizon", t0=t0_w, t1=t1,
+                            steps=executed, tokens=toks)
 
     def _drain_block(self, tok_block, lp_block, active: np.ndarray, h: int):
         """Walk an [R, h] token/logprob block through the exact per-token
@@ -3065,6 +3440,7 @@ class ServingEngine:
         """One blocking device->host materialization (the per-step cost the
         fused horizon amortizes over H tokens)."""
         self.metrics["host_syncs"] += 1
+        self.hists["tick_sync_s"].observe(seconds)
         self.metrics["host_sync_s"] = round(
             self.metrics["host_sync_s"] + seconds, 6)
 

@@ -25,6 +25,7 @@ from aiohttp import web
 from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
                                          ServingEngine, next_stream_item)
 from ipex_llm_tpu.serving.faults import EngineOverloaded
+from ipex_llm_tpu.serving.observe import Tracer, parse_traceparent
 
 HEARTBEAT_INTERVAL_S = 45.0
 
@@ -69,6 +70,11 @@ class FastChatWorker:
             web.post("/count_token", self.api_count_token),
             web.post("/model_details", self.api_model_details),
             web.post("/worker_get_conv_template", self.api_conv_template),
+            # observability surface (serving/observe.py): the same
+            # /trace + /debug/flight views api_server exposes, so a
+            # FastChat fleet is traceable/postmortem-able too
+            web.get("/trace/{trace_id}", self.api_trace),
+            web.get("/debug/flight", self.api_flight),
         ])
         # graceful drain on SIGTERM (reference workers restart-on-error;
         # here the replica finishes in-flight requests before exiting)
@@ -132,6 +138,10 @@ class FastChatWorker:
         if not bool(params.get("do_sample", temperature > 0)):
             temperature = 0.0
         tk = int(params.get("top_k", -1))
+        # W3C trace context rides the worker protocol's JSON params (the
+        # protocol is body-shaped; HTTP callers may also put the header
+        # value here) — the engine's spans then key to the caller's trace
+        tp = parse_traceparent(params.get("traceparent"))
         req = Request(
             prompt_ids=list(map(int, ids)),
             max_new_tokens=int(params.get("max_new_tokens", 256)),
@@ -140,6 +150,7 @@ class FastChatWorker:
             top_k=0 if tk <= 0 else tk,
             eos_token_id=tuple(self._eos) + stop_ids,
             stop_strings=list(stop),
+            trace_id=tp[0] if tp else None,
         )
         return req, len(ids)
 
@@ -250,6 +261,21 @@ class FastChatWorker:
         # templating lives client-side for this worker (one_shot default)
         return web.json_response({"conv": None})
 
+    async def api_trace(self, request: web.Request):
+        tid = request.match_info["trace_id"]
+        tr = self.engine.trace_view(tid)
+        if tr is None:
+            return web.json_response(
+                {"error": f"unknown trace {tid!r} (tracing disabled, or "
+                          "aged out)", "error_code": ERROR_CODE_INTERNAL},
+                status=404)
+        if request.query.get("format") == "chrome":
+            return web.json_response(Tracer.chrome_events([tr]))
+        return web.json_response(tr)
+
+    async def api_flight(self, request: web.Request):
+        return web.json_response(self.engine.flight.view())
+
 
 def build_worker(model_path: str, low_bit: str = "sym_int4",
                  controller_addr: str | None = None,
@@ -325,6 +351,10 @@ def main(argv=None):
     ap.add_argument("--decode-horizon", type=int, default=1, metavar="H",
                     help="fused multi-step decode: H decode steps per "
                          "device program, one host sync per H tokens")
+    ap.add_argument("--trace", action="store_true",
+                    help="request-lifecycle tracing (per-request spans "
+                         "staged in the transactional tick; /trace/{id} "
+                         "and the caller's traceparent honored)")
     ap.add_argument("--no-register", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=30.0,
                     metavar="SECONDS",
@@ -343,7 +373,8 @@ def main(argv=None):
                          kv_storage=args.kv_storage,
                          kv_pool_bytes=args.kv_pool_bytes,
                          spec_k=args.spec_k, spec_ngram=args.spec_ngram,
-                         decode_horizon=args.decode_horizon))
+                         decode_horizon=args.decode_horizon,
+                         trace_requests=args.trace))
     if w.controller_addr:
         async def on_start(app):
             app["hb"] = asyncio.create_task(w.heartbeat_loop())

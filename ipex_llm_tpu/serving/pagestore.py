@@ -100,18 +100,26 @@ class PageStore:
         k_page, v_page = entry
         self.lru.put(key, entry, self._nbytes(k_page, v_page))
 
-    def record_swap_in(self, seconds: float):
+    def record_swap_in(self, seconds: float, pages: int = 1):
+        """Count ``pages`` promoted in one timed promotion (the engine
+        batches a whole prefix chain into one scatter + one completion
+        barrier, so one latency figure can cover several pages —
+        ``swap_ins`` stays per-page so ``swap_in_hit_rate`` against the
+        per-page ``swap_in_lookups`` stays honest)."""
         self._mut += 1
-        self.swap_ins += 1
+        self.swap_ins += pages
         self.swap_in_s.append(seconds)
 
     def peek(self, key: bytes):
         """Non-consuming, non-counting read (the export path serves
-        spilled pages without disturbing swap-in economics)."""
-        if key not in self.lru:
-            return None
-        self._mut += 1          # the LRU hit counter still advances
-        return self.lru.get(key, touch=False)
+        spilled pages without disturbing swap-in economics).  Truly
+        side-effect-free: no hit/miss accounting and — crucially — no
+        ``_mut`` bump, so an export does NOT invalidate the snapshot
+        memo and the next (untouched-store) checkpoint stays O(1).
+        (It used to route through ``lru.get`` and advance ``_mut``,
+        which re-copied the whole store bookkeeping on the tick after
+        every export — the ROADMAP item 1 follow-up.)"""
+        return self.lru.peek(key)
 
     # -- observability ------------------------------------------------------
 

@@ -78,6 +78,10 @@ except ImportError as _e:  # pragma: no cover
 from ipex_llm_tpu.serving.faults import (FaultInjector, ReplicaConnectRefused,
                                          ReplicaFault, ReplicaSlowHealth,
                                          ReplicaStreamHang)
+from ipex_llm_tpu.serving.observe import (LATENCY_BUCKETS_S, Histogram,
+                                          Tracer, make_traceparent,
+                                          new_trace_id, parse_traceparent,
+                                          span)
 
 __all__ = [
     "Backend",
@@ -183,6 +187,23 @@ class RouterConfig:
     # monolithic path.  0 disables handoff.
     disagg_prefill_chars: int = 0
     handoff_timeout_s: float = 120.0  # per-handoff-leg budget
+    # request-lifecycle tracing (serving/observe.py): the router records
+    # its OWN spans per request — route attempts, backpressure
+    # re-routes, failover replays, both disagg handoff legs — keyed by
+    # the W3C traceparent trace id it either receives from the client or
+    # mints, and propagates the traceparent to the replica (carried in
+    # the forwarded body; HTTPBackend promotes it to a real HTTP
+    # header), so /trace/{id} assembles the request's whole life across
+    # processes.  Pure host bookkeeping per attempt; False turns the
+    # router tracer off entirely.
+    tracing: bool = True
+    trace_buffer: int = 512          # traces the router retains (LRU)
+    # shared-token authn for the /kv/import handoff leg: forwarded as
+    # the X-KV-Import-Token header so replicas started with
+    # --kv-import-token accept the router's page sets while rejecting
+    # unauthenticated callers (integrity != authn: a checksum-consistent
+    # blob from anyone would otherwise poison the shared prefix cache).
+    kv_import_token: str | None = None
 
 
 class _Replica:
@@ -357,6 +378,10 @@ class Backend:
 
     target = "?"
     injector: FaultInjector | None = None
+    # shared-token authn for /kv/import, set by the router from its
+    # config: transports that speak real HTTP forward it as the
+    # X-KV-Import-Token header
+    kv_import_token: str | None = None
 
     def _fault(self, site: str):
         """Guarded replica-tier site: translate an injected ReplicaFault
@@ -455,6 +480,15 @@ class HTTPBackend(Backend):
             raise BackendError(f"GET {path}: {type(e).__name__}: {e}",
                                stage="connect")
 
+    @staticmethod
+    def _tp_headers(body: dict) -> dict:
+        """Promote a forwarded-body ``traceparent`` to the real W3C HTTP
+        header (the Backend protocol stays body-shaped so scripted test
+        backends need no transport knowledge; the wire speaks the
+        standard header either way)."""
+        tp = body.get("traceparent")
+        return {"traceparent": str(tp)} if tp else {}
+
     async def send_json(self, path: str, body: dict,
                         timeout: float) -> tuple[int, dict, bytes]:
         """Non-streaming request: the whole response body is read before
@@ -464,6 +498,7 @@ class HTTPBackend(Backend):
         try:
             async with sess.post(
                 f"{self.base_url}{path}", json=body,
+                headers=self._tp_headers(body),
                 timeout=aiohttp.ClientTimeout(total=timeout),
             ) as resp:
                 payload = await resp.read()
@@ -477,10 +512,13 @@ class HTTPBackend(Backend):
                          timeout: float) -> tuple[int, dict, bytes]:
         self._fault("replica-connect")
         sess = await self._sess()
+        hdrs = {"Content-Type": "application/octet-stream"}
+        if self.kv_import_token:
+            hdrs["X-KV-Import-Token"] = self.kv_import_token
         try:
             async with sess.post(
                 f"{self.base_url}{path}", data=data,
-                headers={"Content-Type": "application/octet-stream"},
+                headers=hdrs,
                 timeout=aiohttp.ClientTimeout(total=timeout),
             ) as resp:
                 payload = await resp.read()
@@ -504,6 +542,7 @@ class HTTPBackend(Backend):
             resp = await asyncio.wait_for(
                 sess.post(
                     f"{self.base_url}{path}", json=body,
+                    headers=self._tp_headers(body),
                     # no total timeout: a stream lives as long as it
                     # emits; silence is bounded per-read below instead
                     timeout=aiohttp.ClientTimeout(
@@ -601,11 +640,15 @@ class InProcessBackend(HTTPBackend):
 
     def __init__(self, engine_factory: Callable[[], Any], tokenizer,
                  model_name: str = "fleet",
-                 injector: FaultInjector | None = None):
+                 injector: FaultInjector | None = None,
+                 kv_import_token: str | None = None):
         super().__init__("http://127.0.0.1:0", injector=injector)
         self.engine_factory = engine_factory
         self.tokenizer = tokenizer
         self.model_name = model_name
+        # token the replica's /kv/import REQUIRES (distinct from the
+        # inherited kv_import_token attr the router sets for sending)
+        self.require_kv_import_token = kv_import_token
         self.engine = None
         self.server = None
         self._runner = None
@@ -617,7 +660,9 @@ class InProcessBackend(HTTPBackend):
 
         self.engine = self.engine_factory()
         self.server = OpenAIServer(self.engine, self.tokenizer,
-                                   self.model_name)
+                                   self.model_name,
+                                   kv_import_token=self
+                                   .require_kv_import_token)
         self._runner = web.AppRunner(self.server.app, shutdown_timeout=1.0)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, "127.0.0.1", self.port)
@@ -772,6 +817,21 @@ class Router:
                          for i, (b, role) in enumerate(zip(backends,
                                                            roles))]
         self.router_id = uuid.uuid4().hex
+        # request-lifecycle tracing (observe.py): the router's own spans,
+        # keyed by the traceparent trace id it receives or mints; the
+        # /trace/{id} endpoint merges these with every replica's spans
+        self.tracer = (Tracer(self.rc.trace_buffer)
+                       if self.rc.tracing else None)
+        # honest handoff-leg latency histograms (Prometheus
+        # _bucket/_sum/_count on /metrics) — the two legs are the disagg
+        # path's operational cost and had no distribution until now
+        self.hists = {
+            "handoff_prefill_s": Histogram(LATENCY_BUCKETS_S),
+            "handoff_import_s": Histogram(LATENCY_BUCKETS_S),
+        }
+        for b in backends:
+            # transports forward this as the X-KV-Import-Token header
+            b.kv_import_token = self.rc.kv_import_token
         self._inflight = 0
         self._affinity: "OrderedDict[str, tuple[int, int]]" = OrderedDict()
         self._poll_task: asyncio.Task | None = None
@@ -1044,17 +1104,44 @@ class Router:
             budget = 0.0
         return (time.monotonic() + budget) if budget > 0 else None
 
-    def _fwd_body(self, body: dict, deadline: float | None) -> dict:
+    def _fwd_body(self, body: dict, deadline: float | None,
+                  tid: str | None = None) -> dict:
         """Per-attempt forwarded body: the REMAINING deadline budget is
         stamped so a failover attempt runs under what is left, not a
-        fresh allowance."""
+        fresh allowance — and the traceparent rides along (HTTPBackend
+        promotes it to the real W3C header) so the replica's spans key
+        to the same trace the router's do."""
         fwd = dict(body)
         if deadline is not None:
             fwd["deadline_s"] = max(0.001,
                                     round(deadline - time.monotonic(), 3))
         else:
             fwd.pop("deadline_s", None)
+        if tid is not None:
+            fwd["traceparent"] = make_traceparent(tid)
+        else:
+            fwd.pop("traceparent", None)
         return fwd
+
+    def _trace_tid(self, body: dict,
+                   trace_id: str | None = None) -> str | None:
+        """The request's trace id: caller-passed (the HTTP handlers parse
+        the client's traceparent header), or the body's own traceparent,
+        or freshly minted when the router traces — None only with
+        tracing off and no inherited id (then nothing propagates)."""
+        if trace_id:
+            return trace_id
+        parsed = parse_traceparent(body.get("traceparent"))
+        if parsed is not None:
+            return parsed[0]
+        return new_trace_id() if self.tracer is not None else None
+
+    def _rspan(self, tid: str | None, name: str, t0: float | None = None,
+               t1: float | None = None, **attrs):
+        if self.tracer is None or tid is None:
+            return
+        self.tracer.add(tid, span(name, time.time() if t0 is None else t0,
+                                  t1, origin="router", **attrs))
 
     def _admit(self, surface: str) -> RouterResponse | None:
         """Bounded router inbox: beyond ``max_inflight`` the router sheds
@@ -1140,8 +1227,24 @@ class Router:
                 and len(self._prompt_text(path, body))
                 >= self.rc.disagg_prefill_chars)
 
+    def _handoff_strike(self, rep: _Replica, e, deadline: float | None,
+                        leg: str):
+        """Health accounting for a failed handoff leg — with the PR 10
+        no-strike-on-deadline rule restored for disagg: a leg whose
+        budget was clamped to a nearly-spent CLIENT deadline and that
+        timed out AT that deadline says nothing about the replica
+        (short-deadline clients must not be able to eject healthy
+        prefill/decode replicas), so it counts a handoff failure but no
+        strike.  Anything else is a genuine transport death."""
+        stage = getattr(e, "stage", "fault")
+        if (deadline is not None and stage == "stall"
+                and time.monotonic() >= deadline):
+            return "deadline"
+        self._note_transport_failure(rep, f"handoff_{stage}")
+        return stage
+
     async def _handoff(self, path: str, body: dict, key: str | None,
-                       deadline: float | None):
+                       deadline: float | None, tid: str | None = None):
         """Disaggregated prefill: compute the prompt's KV pages on a
         prefill-role replica (/kv/prefill), import them into a
         decode-role replica (/kv/import), and home the prompt's affinity
@@ -1190,20 +1293,28 @@ class Router:
         if deadline is not None:
             budget = min(budget, max(deadline - now, 0.001))
         pre.inflight += 1
+        t_leg = time.time()
         try:
             pre.backend._fault("replica-handoff")
             status, headers, blob = await pre.backend.send_json(
-                "/kv/prefill", self._fwd_body(body, deadline), budget)
+                "/kv/prefill", self._fwd_body(body, deadline, tid), budget)
         except (BackendError, ReplicaFault) as e:
             # ReplicaFault covers injected shapes _fault does not
             # translate (e.g. a scripted stream-hang at this site): any
-            # of them is still just a zero-delivery handoff death
-            self._note_transport_failure(
-                pre, f"handoff_{getattr(e, 'stage', 'fault')}")
+            # of them is still just a zero-delivery handoff death —
+            # unless the leg merely ran out of the CLIENT's nearly-spent
+            # deadline, which is no evidence against the replica
+            outcome = self._handoff_strike(pre, e, deadline, "prefill")
             self.counters["handoff_failures"] += 1
+            self._rspan(tid, "handoff_prefill", t0=t_leg, t1=time.time(),
+                        replica=pre.idx, outcome=outcome)
             return
         finally:
             pre.inflight -= 1
+        self.hists["handoff_prefill_s"].observe(time.time() - t_leg)
+        self._rspan(tid, "handoff_prefill", t0=t_leg, t1=time.time(),
+                    replica=pre.idx, status=status,
+                    bytes=len(blob) if status == 200 else 0)
         if status != 200:
             # replica-authored refusal (shed / nothing-to-export): no
             # health strike, just no handoff this time
@@ -1213,6 +1324,7 @@ class Router:
             self.counters["handoff_failures"] += 1
             return
         dec.inflight += 1
+        t_leg = time.time()
         try:
             dec.backend._fault("replica-handoff")
             s2, _, _ = await dec.backend.send_bytes("/kv/import", blob,
@@ -1222,13 +1334,19 @@ class Router:
                 # a capability gap is not a death: no health strike,
                 # but remember it so later handoffs skip this replica
                 dec.handoff_broken = True
+                outcome = "unsupported"
             else:
-                self._note_transport_failure(
-                    dec, f"handoff_{getattr(e, 'stage', 'fault')}")
+                # same no-strike-on-client-deadline rule as leg 1
+                outcome = self._handoff_strike(dec, e, deadline, "import")
             self.counters["handoff_failures"] += 1
+            self._rspan(tid, "handoff_import", t0=t_leg, t1=time.time(),
+                        replica=dec.idx, outcome=outcome)
             return
         finally:
             dec.inflight -= 1
+        self.hists["handoff_import_s"].observe(time.time() - t_leg)
+        self._rspan(tid, "handoff_import", t0=t_leg, t1=time.time(),
+                    replica=dec.idx, status=s2, bytes=len(blob))
         if s2 != 200:
             if s2 == 400:
                 # the importer REJECTED the page set (shape/format skew
@@ -1243,7 +1361,8 @@ class Router:
         # pick routes the stream (and future same-prefix requests) there
         self._record_affinity(key, dec)
 
-    async def dispatch_json(self, path: str, body: dict) -> RouterResponse:
+    async def dispatch_json(self, path: str, body: dict,
+                            trace_id: str | None = None) -> RouterResponse:
         """Non-streaming request through the fleet.  Nothing reaches the
         client until a replica's full response is in hand, so EVERY
         transport failure is safely replayable (bounded attempts, the
@@ -1257,11 +1376,13 @@ class Router:
         self.counters["requests"] += 1
         self._inflight += 1
         try:
-            return await self._json_attempts(path, body, surface)
+            return await self._json_attempts(path, body, surface,
+                                             self._trace_tid(body, trace_id))
         finally:
             self._inflight -= 1
 
-    async def _json_attempts(self, path, body, surface) -> RouterResponse:
+    async def _json_attempts(self, path, body, surface,
+                             tid=None) -> RouterResponse:
         deadline = self._deadline(body)
         key = self._prefix_key(path, body)
         tried: set[int] = set()
@@ -1277,15 +1398,19 @@ class Router:
             attempts += 1
             if replay_pending:
                 self.counters["failovers"] += 1
+                self._rspan(tid, "failover", attempt=attempts)
                 replay_pending = False
             timeout = (deadline - time.monotonic() if deadline is not None
                        else self.rc.request_timeout_s)
             rep.counters["requests"] += 1
             rep.inflight += 1
+            t_a = time.time()
             try:
                 status, headers, payload = await rep.backend.send_json(
-                    path, self._fwd_body(body, deadline), timeout)
+                    path, self._fwd_body(body, deadline, tid), timeout)
             except BackendError as e:
+                self._rspan(tid, "route_attempt", t0=t_a, t1=time.time(),
+                            replica=rep.idx, outcome=f"transport_{e.stage}")
                 if (deadline is not None and e.stage == "stall"
                         and time.monotonic() >= deadline):
                     # the REQUEST ran out of budget mid-generation — that
@@ -1302,14 +1427,19 @@ class Router:
                 rep.inflight -= 1
             if status in (429, 503):
                 self._note_shed(rep, headers, tried)
+                self._rspan(tid, "backpressure_reroute", t0=t_a,
+                            t1=time.time(), replica=rep.idx, status=status)
                 attempts -= 1   # backpressure re-route is not a failover
                 continue
             rep.on_success(time.monotonic())
             self._record_affinity(key, rep)
+            self._rspan(tid, "route_attempt", t0=t_a, t1=time.time(),
+                        replica=rep.idx, status=status, outcome="ok")
             return RouterResponse(status, payload, self._fwd_headers(headers))
 
-    async def dispatch_stream(self, path: str,
-                              body: dict) -> RouterResponse | RouterStream:
+    async def dispatch_stream(self, path: str, body: dict,
+                              trace_id: str | None = None,
+                              ) -> RouterResponse | RouterStream:
         """Streaming request through the fleet.  Failover runs until the
         FIRST event is acquired from a replica (nothing delivered ⇒ replay
         is safe and invisible); from then on the stream is committed to
@@ -1324,6 +1454,7 @@ class Router:
         self._inflight += 1
         deadline = self._deadline(body)
         key = self._prefix_key(path, body)
+        tid = self._trace_tid(body, trace_id)
         tried: set[int] = set()
         attempts = 0
         committed = False   # a RouterStream owns the _inflight slot; every
@@ -1335,7 +1466,7 @@ class Router:
                 # prompt's affinity on the importing decode replica,
                 # any failure falls through to the ordinary loop below
                 # with zero tokens delivered
-                await self._handoff(path, body, key, deadline)
+                await self._handoff(path, body, key, deadline, tid)
             while True:
                 rep, done = self._next_replica(surface, key, tried,
                                                attempts, deadline)
@@ -1344,22 +1475,31 @@ class Router:
                 attempts += 1
                 if replay_pending:
                     self.counters["failovers"] += 1
+                    self._rspan(tid, "failover", attempt=attempts)
                     replay_pending = False
                 rep.counters["requests"] += 1
                 rep.inflight += 1
+                t_a = time.time()
                 try:
                     opened = await rep.backend.open_sse(
-                        path, self._fwd_body(body, deadline),
+                        path, self._fwd_body(body, deadline, tid),
                         self.rc.stall_timeout_s,
                         self.rc.first_event_timeout_s)
                     if opened.events is None:
                         if opened.status in (429, 503):
                             self._note_shed(rep, opened.headers, tried)
+                            self._rspan(tid, "backpressure_reroute",
+                                        t0=t_a, t1=time.time(),
+                                        replica=rep.idx,
+                                        status=opened.status)
                             attempts -= 1
                             continue
                         # replica-authored pre-stream outcome (408/500/
                         # 400...): forwarded verbatim, like one replica
                         rep.on_success(time.monotonic())
+                        self._rspan(tid, "route_attempt", t0=t_a,
+                                    t1=time.time(), replica=rep.idx,
+                                    status=opened.status, outcome="ok")
                         return RouterResponse(
                             opened.status, opened.payload or b"",
                             self._fwd_headers(opened.headers))
@@ -1374,12 +1514,19 @@ class Router:
                                            stage="read")
                     rep.on_success(time.monotonic())
                     self._record_affinity(key, rep)
+                    self._rspan(tid, "route_attempt", t0=t_a,
+                                t1=time.time(), replica=rep.idx,
+                                outcome="stream_committed")
                     committed = True
                     release = self._release_once(rep)
                     return RouterStream(
-                        self._relay(rep, gen, first, surface, release),
+                        self._relay(rep, gen, first, surface, release,
+                                    tid=tid),
                         release, upstream=gen)
                 except BackendError as e:
+                    self._rspan(tid, "route_attempt", t0=t_a,
+                                t1=time.time(), replica=rep.idx,
+                                outcome=f"transport_{e.stage}")
                     self._note_transport_failure(rep, f"stream_{e.stage}",
                                                  tried)
                     replay_pending = True
@@ -1409,7 +1556,7 @@ class Router:
         return release
 
     async def _relay(self, rep: _Replica, gen, first: bytes, surface: str,
-                     release):
+                     release, tid: str | None = None):
         """Forward events from the committed replica; on mid-stream death
         append the surface's terminal error object (+ [DONE] on the
         OpenAI framing) so the client always sees a terminal event."""
@@ -1424,6 +1571,8 @@ class Router:
         except BackendError as e:
             self._note_transport_failure(rep, f"midstream_{e.stage}")
             self.counters["midstream_errors"] += 1
+            self._rspan(tid, "midstream_error", replica=rep.idx,
+                        delivered=delivered, stage=e.stage)
             err = _error_payload(
                 surface,
                 f"replica died mid-stream after {delivered} events "
@@ -1479,6 +1628,47 @@ class Router:
 
     # -- aggregated observability -------------------------------------------
 
+    async def assemble_trace(self, trace_id: str) -> dict | None:
+        """One end-to-end trace: the router's own spans merged with every
+        replica's ``/trace/{id}`` spans (re-tagged ``replicaN:engine``),
+        sorted on the shared wall-clock timeline — the cross-process
+        assembly the propagated traceparent exists for.  None when no
+        process holds the trace."""
+        own = self.tracer.get(trace_id) if self.tracer is not None else None
+        spans = list(own["spans"]) if own else []
+        dropped = own["spans_dropped"] if own else 0
+
+        # concurrent fan-out under the probe budget (metrics_text's
+        # pattern): one wedged replica must not stall the postmortem
+        # surface for its whole 10 s default — traces are fetched
+        # exactly when a replica is sick
+        async def fetch(rep: _Replica):
+            try:
+                return await rep.backend.get_json(
+                    f"/trace/{trace_id}", timeout=self.rc.probe_timeout_s)
+            except BackendError:
+                return None   # an unreachable replica costs spans, not a 500
+
+        got = await asyncio.gather(*(fetch(r) for r in self.replicas))
+        for rep, res in zip(self.replicas, got):
+            if res is None or res[0] != 200:
+                continue
+            try:
+                data = json.loads(res[1])
+            except ValueError:
+                continue
+            for s in data.get("spans", []):
+                s = dict(s)
+                s["origin"] = (f"replica{rep.idx}:"
+                               f"{s.get('origin') or 'engine'}")
+                spans.append(s)
+            dropped += data.get("spans_dropped", 0)
+        if not spans:
+            return None
+        spans.sort(key=lambda s: (s["t0"], s["name"]))
+        return {"trace_id": trace_id, "spans": spans,
+                "spans_dropped": dropped}
+
     def health_view(self) -> dict:
         now = time.monotonic()
         routable = sum(1 for r in self.replicas if r.routable(now))
@@ -1499,14 +1689,21 @@ class Router:
 
     async def metrics_text(self) -> str:
         """Prometheus-style aggregation: the router's own counters plus
-        every reachable replica's counters re-labelled per replica, and
-        fleet-wide sums — one scrape shows the whole tier."""
+        every reachable replica's counters re-labelled per replica,
+        fleet-wide sums — and real histogram series: the router's
+        handoff-leg histograms, plus fleet-SUMMED latency histograms
+        (bucket counts are true counters, so summing them across
+        replicas is the one honest fleet aggregation; the old rolling
+        p95 scalars could not be combined at all)."""
         lines = []
         view = self.health_view()["router"]
         for name in sorted(view):
             v = view[name]
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 lines.append(f"ipex_llm_tpu_router_{name} {v}")
+        for name in sorted(self.hists):
+            lines.extend(self.hists[name].prometheus_lines(
+                f"ipex_llm_tpu_router_{name}"))
 
         async def fetch(rep: _Replica):
             try:
@@ -1517,6 +1714,7 @@ class Router:
 
         got = await asyncio.gather(*(fetch(r) for r in self.replicas))
         sums: dict[str, float] = {}
+        hist_sums: dict[str, Histogram] = {}
         for rep, res in got:
             if not res:
                 continue
@@ -1531,9 +1729,21 @@ class Router:
                     f'replica_id="{rid}"}} {v}')
                 if name in _FLEET_SUMMABLE:
                     sums[name] = sums.get(name, 0) + v
+            for hname, hd in sorted((res.get("histograms") or {}).items()):
+                agg = hist_sums.get(hname)
+                if agg is None:
+                    try:
+                        agg = hist_sums[hname] = Histogram(
+                            hd.get("bounds") or LATENCY_BUCKETS_S)
+                    except ValueError:
+                        continue
+                agg.merge(hd)   # refuses (skips) mismatched buckets
         for name in sorted(sums):
             lines.append(f"ipex_llm_tpu_fleet_{name} "
                          f"{round(sums[name], 6)}")
+        for hname in sorted(hist_sums):
+            lines.extend(hist_sums[hname].prometheus_lines(
+                f"ipex_llm_tpu_fleet_{hname}"))
         return "\n".join(lines) + "\n"
 
     # -- aiohttp surface ------------------------------------------------------
@@ -1550,6 +1760,9 @@ class Router:
         app.router.add_get("/v1/models", self._h_models)
         app.router.add_get("/health", self._h_health)
         app.router.add_get("/metrics", self._h_metrics)
+        app.router.add_get("/trace/{trace_id}", self._h_trace)
+        app.router.add_get("/debug/traces", self._h_traces)
+        app.router.add_get("/debug/flight", self._h_flight)
         return app
 
     @staticmethod
@@ -1560,11 +1773,26 @@ class Router:
                             content_type=ctype.split(";")[0],
                             headers=headers)
 
-    async def _stream_out(self, request, res: RouterStream):
-        resp = web.StreamResponse(headers={
+    def _req_trace_id(self, request) -> str | None:
+        """Trace id for one HTTP request: the client's traceparent header
+        when present (so callers control/correlate their own traces),
+        else freshly minted when the router traces.  Echoed back as
+        X-Trace-Id so a client that did NOT send a traceparent can still
+        fetch /trace/{id}."""
+        parsed = parse_traceparent(request.headers.get("traceparent"))
+        if parsed is not None:
+            return parsed[0]
+        return new_trace_id() if self.tracer is not None else None
+
+    async def _stream_out(self, request, res: RouterStream,
+                          trace_id: str | None = None):
+        headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
-        })
+        }
+        if trace_id:
+            headers["X-Trace-Id"] = trace_id
+        resp = web.StreamResponse(headers=headers)
         # prepare() is inside the guarded region: a client that
         # disconnects before (or while) headers go out must still close
         # the committed upstream and release its inflight slots
@@ -1580,26 +1808,40 @@ class Router:
             raise
         return resp
 
+    @staticmethod
+    def _with_trace_header(resp: "web.Response",
+                           trace_id: str | None) -> "web.Response":
+        if trace_id:
+            resp.headers["X-Trace-Id"] = trace_id
+        return resp
+
     async def _h_openai(self, request):
         body = await request.json()
+        tid = self._req_trace_id(request)
         if body.get("stream"):
-            res = await self.dispatch_stream(request.path, body)
+            res = await self.dispatch_stream(request.path, body,
+                                             trace_id=tid)
             if isinstance(res, RouterStream):
-                return await self._stream_out(request, res)
-            return self._respond(res)
-        return self._respond(
-            await self.dispatch_json(request.path, body))
+                return await self._stream_out(request, res, trace_id=tid)
+            return self._with_trace_header(self._respond(res), tid)
+        return self._with_trace_header(
+            self._respond(await self.dispatch_json(request.path, body,
+                                                   trace_id=tid)), tid)
 
     async def _h_tgi(self, request):
-        return self._respond(
-            await self.dispatch_json("/generate", await request.json()))
+        tid = self._req_trace_id(request)
+        return self._with_trace_header(
+            self._respond(await self.dispatch_json(
+                "/generate", await request.json(), trace_id=tid)), tid)
 
     async def _h_tgi_stream(self, request):
+        tid = self._req_trace_id(request)
         res = await self.dispatch_stream("/generate_stream",
-                                         await request.json())
+                                         await request.json(),
+                                         trace_id=tid)
         if isinstance(res, RouterStream):
-            return await self._stream_out(request, res)
-        return self._respond(res)
+            return await self._stream_out(request, res, trace_id=tid)
+        return self._with_trace_header(self._respond(res), tid)
 
     async def _h_models(self, request):
         now = time.monotonic()
@@ -1624,6 +1866,55 @@ class Router:
         return web.Response(text=await self.metrics_text(),
                             content_type="text/plain")
 
+    async def _h_trace(self, request):
+        """One assembled end-to-end trace; ``?format=chrome`` renders it
+        as Chrome trace-event JSON (chrome://tracing / Perfetto)."""
+        tid = request.match_info["trace_id"]
+        tr = await self.assemble_trace(tid)
+        if tr is None:
+            return web.json_response(
+                {"error": {"message": f"unknown trace {tid!r} (tracing "
+                                      "off, or aged out of the LRU)",
+                           "type": "invalid_request_error",
+                           "code": "unknown_trace"}}, status=404)
+        if request.query.get("format") == "chrome":
+            return web.json_response(Tracer.chrome_events([tr]))
+        return web.json_response(tr)
+
+    async def _h_traces(self, request):
+        """Whole-window export of the router's own spans (per-request
+        assembly across replicas rides /trace/{id}); ``?format=chrome``
+        for the Perfetto shape."""
+        if self.tracer is None:
+            return web.json_response(
+                {"error": {"message": "router tracing is disabled",
+                           "type": "invalid_request_error",
+                           "code": "tracing_disabled"}}, status=404)
+        if request.query.get("format") == "chrome":
+            return web.json_response(self.tracer.export_chrome())
+        return web.json_response({"trace_ids": self.tracer.trace_ids()})
+
+    async def _h_flight(self, request):
+        """Every reachable replica's tick flight recorder, keyed by
+        replica index — the fleet-wide postmortem fetch."""
+        async def fetch(rep: _Replica):
+            try:
+                return await rep.backend.get_json(
+                    "/debug/flight", timeout=self.rc.probe_timeout_s)
+            except BackendError:
+                return None
+
+        got = await asyncio.gather(*(fetch(r) for r in self.replicas))
+        out = {}
+        for rep, res in zip(self.replicas, got):
+            if res is None or res[0] != 200:
+                continue
+            try:
+                out[str(rep.idx)] = json.loads(res[1])
+            except ValueError:
+                continue
+        return web.json_response({"replicas": out})
+
 
 # ---------------------------------------------------------------------------
 # CLI
@@ -1631,10 +1922,15 @@ class Router:
 
 def build_inprocess_fleet(model_path: str, n_replicas: int,
                           low_bit: str = "sym_int4",
-                          engine_config=None) -> list:
+                          engine_config=None,
+                          kv_import_token: str | None = None) -> list:
     """N in-process engine replicas over ONE loaded copy of the weights
     (params are read-only device arrays — every engine shares them; each
-    replica has its own KV pool, queue, and fault domain)."""
+    replica has its own KV pool, queue, and fault domain).
+    ``kv_import_token`` makes every replica's loopback /kv/import
+    REQUIRE the shared token — the in-process replicas listen on real
+    TCP ports, so the poisoning exposure is the same as the
+    multi-process deployment's."""
     from transformers import AutoTokenizer
 
     from ipex_llm_tpu.serving.engine import ServingEngine
@@ -1653,7 +1949,8 @@ def build_inprocess_fleet(model_path: str, n_replicas: int,
         return ServingEngine(model.config, model.params, engine_config,
                              default_eos=eos).start()
 
-    return [InProcessBackend(factory, tok, model_name=model_path)
+    return [InProcessBackend(factory, tok, model_name=model_path,
+                             kv_import_token=kv_import_token)
             for _ in range(n_replicas)]
 
 
@@ -1713,6 +2010,16 @@ def main(argv=None):
                          "a prefill-role replica computes the KV pages, "
                          "a decode-role replica imports them and serves "
                          "the stream (0 = off; requires --roles)")
+    ap.add_argument("--kv-import-token", default=None, metavar="TOKEN",
+                    help="shared token forwarded on the /kv/import "
+                         "handoff leg (X-KV-Import-Token); replicas "
+                         "started with the same --kv-import-token "
+                         "reject unauthenticated page-set imports")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable router-side request-lifecycle tracing "
+                         "(spans, /trace/{id} assembly, traceparent "
+                         "minting; client-supplied traceparents still "
+                         "propagate)")
     args = ap.parse_args(argv)
 
     rc = RouterConfig(
@@ -1727,12 +2034,15 @@ def main(argv=None):
         max_inflight=args.max_inflight,
         request_deadline_s=args.request_deadline,
         disagg_prefill_chars=args.disagg_prefill_chars,
+        kv_import_token=args.kv_import_token,
+        tracing=not args.no_trace,
     )
     if args.replicas.isdigit():
         if not args.model:
             ap.error("--model is required for the in-process fleet form")
-        backends = build_inprocess_fleet(args.model, int(args.replicas),
-                                         args.low_bit)
+        backends = build_inprocess_fleet(
+            args.model, int(args.replicas), args.low_bit,
+            kv_import_token=args.kv_import_token)
     else:
         backends = [HTTPBackend(u.strip())
                     for u in args.replicas.split(",") if u.strip()]
