@@ -256,6 +256,44 @@ def bench_spec_verify(r, hq, hkv, maxp, ps, d, k_spec, dtype=jnp.bfloat16,
             "xla_gbs": round(nbytes / tc / 1e9, 1)}
 
 
+def bench_collectives(rows=8, hidden=4096, tp=4, iters=50):
+    """Per-call cost of one decode-shaped AllReduce per wire family
+    (ops/collectives.py: bf16-exact / e5m2 / int8) — the measured table
+    behind the collective family ladder.  The payload is the row-parallel
+    combine the manual-tp tick pays twice per layer: [rows, hidden] f32
+    partials reduced over the tp axis inside a fully-manual shard_map
+    region.  On the CPU mesh the numbers price the family's code/decode
+    arithmetic (the wire is emulated); on TPU they are the real ICI
+    story.  Refreshes _BUILTIN_COLLECTIVE_LADDER."""
+    from jax.sharding import PartitionSpec as P
+
+    from ipex_llm_tpu.ops import collectives
+    from ipex_llm_tpu.parallel import MeshSpec, make_mesh
+    from ipex_llm_tpu.parallel.compat import shard_map
+
+    if tp > len(jax.devices()):
+        print(f"collectives: skip tp={tp} (have {len(jax.devices())} "
+              "devices)")
+        return []
+    mesh = make_mesh(MeshSpec(tp=tp))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((rows, hidden)), jnp.float32)
+    nbytes = rows * hidden * 4
+    out = []
+    for q in collectives.ALLREDUCE_QTYPES:
+        fn = jax.jit(shard_map(
+            lambda v, q=q: collectives.all_reduce(v, "tp", qtype=q),
+            mesh=mesh, in_specs=P(), out_specs=P(),
+            axis_names={"tp"}, check_vma=False))
+        t = timeit(fn, x, iters=iters)
+        print(f"all_reduce[{q}] [{rows}x{hidden}] tp={tp}: "
+              f"{t*1e6:8.1f}us ({nbytes/t/1e9:6.1f} GB/s payload)")
+        out.append({"op": f"all_reduce_{q}_r{rows}x{hidden}_tp{tp}",
+                    "us": round(t * 1e6, 1),
+                    "gbs": round(nbytes / t / 1e9, 1)})
+    return out
+
+
 def collect(iters: int = 20) -> list[dict]:
     """Compact per-kernel summary for the BENCH artifact (fail-soft: an op
     whose kernel path is ineligible on this backend is skipped).
@@ -340,6 +378,15 @@ def collect(iters: int = 20) -> list[dict]:
         except Exception as e:  # noqa: BLE001 — record, keep benching
             print(f"microbench skip {fn.__name__}{args}: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+    # collective wire families (the manual-tp AllReduce ladder): the
+    # decode-shaped payload on TPU, a smaller one for the CPU-mesh record
+    try:
+        shape = (8, 4096, 4) if on_tpu else (8, 1024, 4)
+        out.extend(bench_collectives(*shape,
+                                     iters=iters if on_tpu else 5))
+    except Exception as e:  # noqa: BLE001
+        print(f"microbench skip bench_collectives: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     return out
 
 
@@ -366,3 +413,5 @@ if __name__ == "__main__":
     # speculative verify: one [R, k+1] pass vs the k+1-step decode chain
     bench_spec_verify(16, 32, 8, 16, 128, 128, 4)
     bench_spec_verify(16, 32, 8, 16, 128, 128, 4, jnp.float8_e5m2)
+    # collective wire families (manual-tp row-parallel combine shape)
+    bench_collectives(8, 4096, 4)

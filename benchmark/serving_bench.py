@@ -132,6 +132,116 @@ def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
         eng.stop()
 
 
+def bench_tp_scaling(cfg, params, engine_config, tps=(1, 2, 4, 8),
+                     concurrency: int = 4, n_in: int = 16, n_out: int = 16,
+                     quantized_tp: int = 4, seed: int = 31) -> list[dict]:
+    """Locked multi-chip tp-scaling matrix (BENCH_r14+): the SAME request
+    wave through one engine per tp degree on the (virtual) CPU mesh —
+    agg tok/s, TTFT percentiles, and the dispatch-per-tick ratio, which
+    must stay ==1 at EVERY degree (the manual shard_map tick is one
+    device program whatever tp; JP106's invariant, measured here at
+    runtime).  Rows stamp the routing decision honestly: ``tp_manual``
+    True means the fully-manual tick served the row, False means the
+    per-op GSPMD fallback did (with the reason), so a scaling regression
+    is attributable to the right program.  After the bf16 ladder, the
+    quantized-collective sub-rows rerun ``quantized_tp`` under the
+    e5m2/int8 wire families (ops/collectives.py, the EQuARX axis) — the
+    less-ICI-bytes-for-bounded-error trade priced against the exact bf16
+    family in-run, on the same wave."""
+    from dataclasses import replace as _dc_replace
+
+    import jax
+
+    from ipex_llm_tpu.parallel import MeshSpec, make_mesh
+    from ipex_llm_tpu.serving.engine import Request, ServingEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+               for _ in range(concurrency)]
+    # warm at FULL wave concurrency: the measured wave's admission
+    # interleavings must all be compiled outside the timed window, or
+    # the tp rows compare compile times instead of serving rates
+    warm_prompts = [list(rng.integers(1, cfg.vocab_size, n_in).astype(int))
+                    for _ in range(concurrency)]
+    n_dev = len(jax.devices())
+
+    def one(tp: int, cq: str) -> dict:
+        mesh = make_mesh(MeshSpec(tp=tp)) if tp > 1 else None
+        ec = _dc_replace(engine_config, collective_qtype=cq)
+        eng = ServingEngine(cfg, params, ec, mesh=mesh).start()
+        try:
+            _warm(eng, warm_prompts)
+            reqs = [Request(prompt_ids=p, max_new_tokens=n_out)
+                    for p in prompts]
+            outs: dict[int, list[int]] = {}
+            t0 = time.perf_counter()
+            _run_wave(eng, reqs, outs)
+            wall = time.perf_counter() - t0
+            # JP106's runtime twin off the flight ring: device programs
+            # dispatched per COMMITTED working tick (idle ticks are
+            # skipped by the recorder)
+            disp_max = max((r.get("dispatches", 0)
+                            for r in eng.flight.ring), default=0)
+            total_tokens = sum(len(v) for v in outs.values())
+            ttfts = [r.first_token_s for r in reqs if r.first_token_s > 0]
+            row = {
+                "workload": "tp_scaling",
+                "tp": tp,
+                "collective_qtype": cq,
+                "tp_manual": bool(getattr(eng, "_tp_manual", False)),
+                "concurrency": concurrency,
+                "n_in": n_in,
+                "n_out": n_out,
+                "agg_tok_s": round(total_tokens / wall, 2),
+                "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+                "ttft_p95_s": round(_percentile(ttfts, 95), 4),
+                # the JP106 runtime twin: max device programs any one
+                # working tick dispatched (the ==1 gate, at every degree)
+                "tick_dispatches": disp_max,
+                "completed": sum(1 for r in reqs
+                                 if r.finish_reason in ("length", "stop")),
+            }
+            if eng._tp_fallback_reason:
+                row["tp_fallback_reason"] = eng._tp_fallback_reason
+            if row["tp_manual"]:
+                # per-shard KV byte math: the head-sharded pool divides
+                # across shards (the docs' "tp byte math" row source)
+                row["kv_pool_bytes_per_shard"] = int(
+                    (eng.cache.k.nbytes + eng.cache.v.nbytes) // tp)
+            return row
+        finally:
+            eng.stop()
+
+    from ipex_llm_tpu.ops import collectives
+
+    base_cq = collectives.resolve_qtype(engine_config.collective_qtype)
+    out: list[dict] = []
+    for tp in tps:
+        if tp > n_dev:
+            print(f"serving_bench skip tp={tp}: only {n_dev} devices",
+                  file=sys.stderr)
+            continue
+        try:
+            out.append(one(tp, base_cq))
+        except Exception as e:  # noqa: BLE001 — partial matrix beats none
+            print(f"serving_bench skip tp={tp}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    base = next((r for r in out if r["tp"] == quantized_tp
+                 and r["tp_manual"]), None)
+    if base is not None:
+        for cq in ("e5m2", "int8"):
+            try:
+                sub = one(quantized_tp, cq)
+                sub["workload"] = "tp_collective_qtype"
+                sub["agg_tok_s_vs_exact"] = round(
+                    sub["agg_tok_s"] / max(base["agg_tok_s"], 1e-9), 3)
+                out.append(sub)
+            except Exception as e:  # noqa: BLE001
+                print(f"serving_bench skip collective_qtype={cq}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+    return out
+
+
 def bench_kv_storage(cfg, params, engine_config, concurrency: int,
                      n_in: int, n_out: int, seed: int = 11) -> dict:
     """Fixed-byte-budget KV-storage row: TWO waves of ``concurrency``
@@ -766,15 +876,33 @@ def _router_wave(port: int, prompts, n_out: int, concurrency: int,
 def bench_replicas(cfg, params, engine_config, n_replicas: int,
                    concurrency: int = 4, n_reqs: int = 8,
                    n_in: int = 16, n_out: int = 16, seed: int = 23,
-                   stream_timeout_s: float = 600.0) -> dict:
+                   stream_timeout_s: float = 600.0,
+                   tp_slice: int = 0) -> dict:
     """Multi-replica ladder row: ``n_reqs`` streams through the router
     over ``n_replicas`` in-process engine replicas — agg tok/s and TTFT
     p95 vs replica count.  On a single CPU host the replicas share the
     device, so the ladder measures the ROUTER's overhead and scheduling,
     not chip scaling; on real multi-chip hosts each replica owns a chip
-    and the same row becomes the scaling story."""
+    and the same row becomes the scaling story.
+
+    ``tp_slice`` > 0 is the MESH-SLICE fleet: each replica owns a
+    DISJOINT ``tp_slice``-device slice of the mesh (replica i gets
+    devices [i*tp_slice, (i+1)*tp_slice)) and serves its share of the
+    fleet through the manual-tp tick on its own slice — the router tier
+    composed with real tensor parallelism, one process, zero shared
+    devices between replicas."""
+    import jax
+
+    from ipex_llm_tpu.parallel import MeshSpec, make_mesh
     from ipex_llm_tpu.serving.engine import ServingEngine
     from ipex_llm_tpu.serving.router import InProcessBackend, RouterConfig
+
+    if tp_slice:
+        devs = jax.devices()
+        if n_replicas * tp_slice > len(devs):
+            raise ValueError(
+                f"mesh-slice fleet needs {n_replicas}x{tp_slice} devices, "
+                f"have {len(devs)}")
 
     rng = np.random.default_rng(seed)
     prompts = [" ".join(str(x) for x in
@@ -785,11 +913,20 @@ def bench_replicas(cfg, params, engine_config, n_replicas: int,
     tok = _BenchTok(cfg.vocab_size)
 
     async def mk_backends():
-        def factory():
-            return ServingEngine(cfg, params, engine_config).start()
+        def factory(slice_idx=None):
+            mesh = None
+            if slice_idx is not None:
+                mesh = make_mesh(
+                    MeshSpec(tp=tp_slice),
+                    devices=devs[slice_idx * tp_slice:
+                                 (slice_idx + 1) * tp_slice])
+            return ServingEngine(cfg, params, engine_config,
+                                 mesh=mesh).start()
 
-        bs = [InProcessBackend(factory, tok, "bench")
-              for _ in range(n_replicas)]
+        bs = [InProcessBackend(
+                  (lambda i=i: factory(i)) if tp_slice else factory,
+                  tok, "bench")
+              for i in range(n_replicas)]
         for b in bs:
             await b.start()
         return bs
@@ -808,7 +945,9 @@ def bench_replicas(cfg, params, engine_config, n_replicas: int,
         total_tokens = sum(len(o["text"].split()) for o in outs)
         ttfts = [o["ttft_s"] for o in outs if o["ttft_s"] > 0]
         return {
-            "workload": "replica_ladder",
+            "workload": ("mesh_slice_fleet" if tp_slice
+                         else "replica_ladder"),
+            **({"tp_slice": tp_slice} if tp_slice else {}),
             "replicas": n_replicas,
             "concurrency": concurrency,
             "n_reqs": n_reqs,
@@ -1509,6 +1648,33 @@ def collect(cfg=None, params=None, levels=(1, 4, 16), n_in: int | None = None,
                                        n_out=churn_out))
     except Exception as e:  # noqa: BLE001
         print(f"serving_bench skip replica_chaos: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # multi-chip tp scaling (BENCH_r14+): the fused tick across the mesh
+    # — the bf16 tp ladder (manual shard_map tick where the model
+    # divides, honest fallback stamp where it does not) plus the
+    # quantized-collective sub-rows (e5m2/int8 wire vs the exact bf16
+    # family, same wave).  On the 8-virtual-device CPU mesh the shards
+    # are host threads, so the ladder prices the manual tick's overhead
+    # and the collective families; on real multi-chip hosts the same
+    # row is the ICI scaling story.
+    try:
+        out.extend(bench_tp_scaling(cfg, params, rep_ec,
+                                    concurrency=4,
+                                    n_in=min(n_in, 16),
+                                    n_out=sweep_out))
+    except Exception as e:  # noqa: BLE001
+        print(f"serving_bench skip tp_scaling: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+    # mesh-slice fleet (the PR 10 remaining item): replicas x disjoint
+    # tp=2 device slices — router tier composed with tensor parallelism
+    # in one process, no device shared between replicas
+    try:
+        out.append(bench_replicas(cfg, params, rep_ec, 4,
+                                  concurrency=4, n_reqs=rep_reqs,
+                                  n_in=min(n_in, 16), n_out=churn_out,
+                                  tp_slice=2))
+    except Exception as e:  # noqa: BLE001
+        print(f"serving_bench skip mesh_slice_fleet: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
     # disaggregated prefill/decode vs monolithic at equal replica count
     # (BENCH_r11+): prefill-heavy shared-prefix mix — the disagg fleet

@@ -117,9 +117,60 @@ def _audit_model(wq: str):
     return cfg, _sds(random_params(cfg, qtype=wq, seed=0))
 
 
+@lru_cache(maxsize=1)
+def audit_model_tp():
+    """(cfg, abstract params) for the MANUAL-TP tick grid: every sharded
+    axis — q/kv heads, the packed qkv/gate_up out widths, the ffn
+    contraction, the vocab — divides by 8, so one model lowers the
+    sharded tick at tp in {1, 2, 4, 8} on the audit's 8 virtual CPU
+    devices."""
+    from ipex_llm_tpu.models.random_init import llama_config, random_params
+
+    cfg = llama_config(hidden_size=32, intermediate_size=64, num_layers=2,
+                       num_heads=8, num_kv_heads=8, head_dim=8,
+                       vocab_size=96, max_position_embeddings=256)
+    return cfg, _sds(random_params(cfg, qtype="bf16", seed=0))
+
+
+def _tp_mesh(tp: int):
+    from ipex_llm_tpu.parallel import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(tp=tp))
+
+
 _POOL_PAGES = 18      # audit pool: pages, page size, table width
 _PAGE = 16
 _MAXP = 4
+
+
+def _tp_paged_cache(tp: int, rows: int, storage: str,
+                    max_pages: int = _MAXP):
+    """Abstract paged pool WITH the real placement's shardings: the
+    engine's cache arrives kv-head-sharded (shard_paged_cache), and the
+    donation alias only forms when the lowered input sharding matches the
+    output's — an unsharded abstract pool would audit a program the
+    engine never dispatches (and falsely flag the pool copy JP101
+    protects against)."""
+    from dataclasses import replace as _dc_replace
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ipex_llm_tpu.kv import PagedKVCache
+
+    cfg, _ = audit_model_tp()
+    cache = _sds(PagedKVCache.init(
+        cfg.num_layers, _POOL_PAGES, rows, max_pages, cfg.num_kv_heads,
+        _PAGE, cfg.head_dim, v_head_dim=cfg.v_dim, storage=storage))
+    mesh = _tp_mesh(tp)
+    pool = NamedSharding(mesh, P(None, None, "tp", None, None))
+    rep = NamedSharding(mesh, P())
+
+    def sh(x, s):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+    return _dc_replace(cache, k=sh(cache.k, pool), v=sh(cache.v, pool),
+                       tables=sh(cache.tables, rep),
+                       length=sh(cache.length, rep))
 
 
 def _paged_cache(rows: int, storage: str, max_pages: int = _MAXP):
@@ -176,10 +227,55 @@ def _build_decode_multi_step(pt):
             _i32(r, 2), _i32(r)), {"horizon": pt["horizon"], "mesh": None}
 
 
+def _tp_stamped_params(tp: int):
+    """The abstract audit_model_tp tree with the manual layout's
+    ``tp_mode`` stamps (the static aux parallel/manual.py's in_specs are
+    derived from) — shapes are unchanged by the relayout permutation, so
+    the abstract tree lowers exactly like a placed one."""
+    from dataclasses import replace as _dc_replace
+
+    from ipex_llm_tpu.parallel.shard import param_shardings
+    from ipex_llm_tpu.quantize.core import QTensor
+
+    cfg, params = audit_model_tp()
+    mesh = _tp_mesh(tp)
+    sh = param_shardings(params, mesh)
+
+    def stamp(p, s, key):
+        if isinstance(p, QTensor) and isinstance(s, QTensor):
+            # the manual layout replicates the embed table
+            return _dc_replace(p, tp_mode=None if key == "embed"
+                               else s.tp_mode)
+        return p
+
+    out = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = {kk: stamp(vv, sh[k][kk], kk) for kk, vv in v.items()}
+        elif isinstance(v, (int, float)):
+            out[k] = v
+        else:
+            out[k] = stamp(v, sh[k], k)
+    return cfg, out, mesh
+
+
 def _build_ragged_tick(pt):
-    cfg, params = audit_model(pt.get("wq", "bf16"))
+    tp = pt.get("tp", 0)
+    if tp > 1:
+        # manual-mesh form: the whole tick inside one fully-manual
+        # shard_map region (parallel/manual.py) over a pure-tp mesh
+        cfg, params, mesh = _tp_stamped_params(tp)
+        cache = _tp_paged_cache(tp, pt["rows"], pt["kv"])
+    else:
+        # tp=1 IS the single-chip program (the engine routes tp<=1 to
+        # the plain path — manual.ineligible_reason): the grid point
+        # exists so the tp axis reads {1, 2, 4, 8}, and dedups against
+        # the matching single-chip row by signature
+        cfg, params = audit_model(pt.get("wq", "bf16"))
+        mesh = None
+        cache = _paged_cache(pt["rows"], pt["kv"])
     r = pt["rows"]
-    base = (cfg, params, _paged_cache(r, pt["kv"]), _i32(r), _i32(r),
+    base = (cfg, params, cache, _i32(r), _i32(r),
             _bool(r), _f32(r), _f32(r), _key(), _i32(r), _i32(r), _i32(r),
             _i32(r, 2), _i32(r))
     w = pt["width"]
@@ -190,7 +286,10 @@ def _build_ragged_tick(pt):
     else:   # steady-state form: pure decode horizon, no prefill block
         prefill = None
     kw = {"prefill": prefill, "horizon": pt["horizon"],
-          "with_decode": pt.get("wd", True), "mesh": None}
+          "with_decode": pt.get("wd", True), "mesh": mesh}
+    if tp > 1:
+        kw.update(tp_manual=True,
+                  collective_qtype=pt.get("cq", "bf16"))
     if pt.get("spec"):
         # speculative form: the device token-history ring (donated, the
         # proposer's input) and the per-row traced draft-width caps ride
@@ -336,7 +435,27 @@ def real_registry() -> tuple[ProgramSpec, ...]:
                   + _grid(rows=(4,), width=(0,), horizon=(1, 8),
                           wq=("sym_int4",), kv=kv_axis)
                   + _grid(rows=(4,), width=(8,), horizon=(1,),
-                          wq=("sym_int4",), kv=("bf16",))),
+                          wq=("sym_int4",), kv=("bf16",))
+                  # manual-mesh tp axis (parallel/manual.py): the whole
+                  # tick inside ONE fully-manual shard_map region over a
+                  # pure-tp CPU mesh, per-shard pools, explicit
+                  # collectives.  tp=1 is the single-chip program by
+                  # construction (dedups by signature); tp in {2, 4, 8}
+                  # lower the sharded steady-state tick, tp=2 also the
+                  # admission-wave and speculative forms, the quantized
+                  # collective families (cq: EQuARX e5m2/int8 wires) and
+                  # the fp8 pool — donation aliases verified per point
+                  # like every other row
+                  + _grid(rows=(4,), width=(0,), horizon=(1,),
+                          tp=(1, 2, 4, 8), kv=("bf16",))
+                  + _grid(rows=(4,), width=(8,), horizon=(1,),
+                          tp=(2,), kv=("bf16",))
+                  + _grid(rows=(4,), width=(0,), horizon=(8,),
+                          spec=(4,), tp=(2,), kv=("bf16",))
+                  + _grid(rows=(4,), width=(0,), horizon=(1,),
+                          tp=(2,), cq=("e5m2", "int8"), kv=("bf16",))
+                  + _grid(rows=(4,), width=(0,), horizon=(1,),
+                          tp=(2,), kv=("fp8",))),
             arg_names=("params", "cache", "toks", "row_lens", "active",
                        "temps", "top_ps", "key", "seeds", "steps",
                        "top_ks", "eos", "remain"),
@@ -350,7 +469,7 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             # purpose
             held=frozenset({"params", "temps", "top_ps", "seeds",
                             "top_ks", "eos", "key"}),
-            max_lowerings=25,
+            max_lowerings=33,
         ),
         ProgramSpec(
             name="serving.decode_multi_step",
@@ -420,7 +539,6 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             dead=frozenset({"cache"}),
             held=frozenset({"params", "key"}),   # key: checkpoint-held
             max_lowerings=1,
-            requires="jax.shard_map",
         ),
         ProgramSpec(
             name="serving.pp_verify_step",
@@ -433,7 +551,6 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             dead=frozenset({"cache"}),
             held=frozenset({"params", "key"}),   # key: checkpoint-held
             max_lowerings=1,
-            requires="jax.shard_map",
         ),
         # -- generation.py ----------------------------------------------
         ProgramSpec(

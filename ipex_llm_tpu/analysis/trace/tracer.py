@@ -81,6 +81,9 @@ def signature(args: tuple, kwargs: dict) -> tuple:
 
 _MAIN_ARG_RE = re.compile(r"%arg(\d+):")
 _ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+# compiled-HLO alias entry: "{out_idx}: (param_number, {}, may-alias)"
+_COMPILED_ALIAS_RE = re.compile(
+    r"\{\s*(\d*)\s*\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(?:may|must)-alias\)")
 
 
 def parse_output_aliases(mlir_text: str) -> dict[int, int]:
@@ -106,6 +109,21 @@ def parse_output_aliases(mlir_text: str) -> dict[int, int]:
                     out[argn] = int(am.group(1))
             return out
     raise ValueError("no public @main function in lowered module")
+
+
+def parse_compiled_aliases(hlo_text: str) -> dict[int, int]:
+    """MLIR-arg-position -> output index, from the COMPILED module's
+    ``input_output_alias`` header — the sharded-program fallback.
+
+    jax 0.4.37 omits the ``tf.aliasing_output`` attrs from the StableHLO
+    whenever an input carries a sharding (manual-mesh programs: the tp
+    tick's head-sharded KV pools), yet the donation is real — XLA
+    establishes the alias at compile time and stamps it on the entry
+    module as ``{out_idx}: (param, {}, may-alias)``.  Parsing that header
+    is the only way to verify a sharded program's donation contract, and
+    compiling costs ~1 s on top of the (already-paid) lowering."""
+    return {int(m.group(2)): int(m.group(1) or 0)
+            for m in _COMPILED_ALIAS_RE.finditer(hlo_text)}
 
 
 def _walk_jaxpr(jaxpr: Jaxpr, callbacks: list[str],
@@ -161,6 +179,12 @@ def trace_entry(spec, point: dict, prebuilt=None) -> TracedEntry:
     kept = sorted(kept) if kept is not None else list(range(len(flat)))
     mlir_pos = {flat_idx: i for i, flat_idx in enumerate(kept)}
     aliases = parse_output_aliases(lowered.as_text())
+    if not aliases and any(getattr(i, "donated", False)
+                           for _, i in flat):
+        # donation requested but the StableHLO shows zero aliases: the
+        # sharded-lowering gap (see parse_compiled_aliases) — pay the
+        # compile and read the aliases XLA actually established
+        aliases = parse_compiled_aliases(lowered.compile().as_text())
 
     leaves = []
     for flat_idx, (path, info) in enumerate(flat):

@@ -433,6 +433,16 @@ def logits_tail(cfg: ModelConfig, params, x):
         logits = linear_ops.linear(
             x, lm_head, params.get("lm_head_bias")
         ).astype(jnp.float32)
+        from ipex_llm_tpu.ops import dispatch as _dispatch
+
+        mt = _dispatch.manual_tp_state()
+        if mt is not None and getattr(lm_head, "tp_mode", None) == "col":
+            # manual-mesh region with a column-parallel lm head: each
+            # shard holds its contiguous vocab slice of the logits —
+            # gather to full width so sampling runs replicated (every
+            # shard draws the same token from the same key).  Exact: an
+            # all-gather moves bits, col-parallel splits no reduction.
+            logits = jax.lax.all_gather(logits, mt[0], axis=-1, tiled=True)
     if cfg.logit_scale != 1.0:  # cohere
         logits = logits * cfg.logit_scale
     if cfg.logit_softcap is not None:

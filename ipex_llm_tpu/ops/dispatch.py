@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from functools import lru_cache
 
 import jax
@@ -62,6 +63,38 @@ def spmd(mesh_or_active):
 def spmd_mesh():
     """The mesh recorded by the innermost ``spmd(mesh)`` scope (or None)."""
     return _spmd_mesh
+
+
+# Manual-mesh tensor parallelism (parallel/manual.py): INSIDE the fully-
+# manual shard_map region the arrays are per-shard slices and GSPMD sees
+# nothing, so dispatch must treat the trace as single-device compute with
+# EXPLICIT collectives at the row-parallel combine points.  The record is
+# (axis_name, collective_qtype): ops/linear.py reads it to psum row-
+# parallel partials through ops/collectives.py under the engine's wire
+# family, and models/decoder.logits_tail reads it to all-gather the
+# vocab-sharded logits before sampling.
+_manual_tp = threading.local()   # thread-local: engines trace on their
+# own threads, and a mesh-slice fleet runs several in one process — a
+# plain global would leak one engine's manual marker into a concurrent
+# non-manual trace
+
+
+@contextmanager
+def manual_tp(axis: str, collective_qtype: str = "bf16"):
+    """Scoped manual-TP marker for code tracing INSIDE a fully-manual
+    shard_map region (mutually exclusive with ``spmd`` — the manual tick
+    never enters the GSPMD dispatch path)."""
+    prev = getattr(_manual_tp, "state", None)
+    _manual_tp.state = (axis, collective_qtype)
+    try:
+        yield
+    finally:
+        _manual_tp.state = prev
+
+
+def manual_tp_state() -> tuple[str, str] | None:
+    """(axis_name, collective_qtype) inside a manual-TP region, else None."""
+    return getattr(_manual_tp, "state", None)
 
 
 # Context-parallel ring attention (ops/ring_attention.py): set by the

@@ -46,22 +46,46 @@ def qmatmul(x: jnp.ndarray, qt: QTensor, compute_dtype=jnp.bfloat16) -> jnp.ndar
     XLA dequant path which GSPMD partitions itself.
     """
     fam = f"qmatmul_{qt.qtype}"
-    mesh = dispatch.spmd_mesh()
-    if (
-        mesh is not None
-        and qt.tp_mode in ("col", "row")
-        and mesh.shape.get("tp", 1) > 1
-        and dispatch.use_pallas_sharded(fam)
-        and qt.qtype in _PALLAS_QTYPES
-    ):
-        try:
-            from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
+    mt = dispatch.manual_tp_state()
+    if mt is not None:
+        # manual-mesh region (parallel/manual.py): the planes are already
+        # per-shard slices and the trace is single-device.  Row-parallel
+        # weights are THE cross-chip math — local f32 partial products
+        # combined through the quantized-collective family at full
+        # accumulator width (casting to x.dtype only AFTER the reduce
+        # keeps the exact family bit-stable against the single-chip
+        # matmul; the Pallas kernel cannot serve here, it emits at
+        # compute_dtype and a narrowed partial would break that
+        # guarantee).  Column/replicated weights are pure local compute
+        # and fall THROUGH to the ordinary single-device ladder below —
+        # the per-shard matmul takes the same measured Pallas-vs-XLA
+        # call the single-chip trace takes.
+        axis, cq = mt
+        if qt.tp_mode == "row":
+            w = qcore.dequantize(qt, dtype=compute_dtype)
+            part = jnp.matmul(x.astype(compute_dtype), w,
+                              preferred_element_type=jnp.float32)
+            from ipex_llm_tpu.ops import collectives
 
-            return pallas_qmatmul.qmatmul_pallas_sharded(
-                x, qt, mesh, compute_dtype
-            )
-        except (ImportError, NotImplementedError):
-            pass
+            return collectives.all_reduce(part, axis, qtype=cq,
+                                          out_dtype=x.dtype)
+    else:
+        mesh = dispatch.spmd_mesh()
+        if (
+            mesh is not None
+            and qt.tp_mode in ("col", "row")
+            and mesh.shape.get("tp", 1) > 1
+            and dispatch.use_pallas_sharded(fam)
+            and qt.qtype in _PALLAS_QTYPES
+        ):
+            try:
+                from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul
+
+                return pallas_qmatmul.qmatmul_pallas_sharded(
+                    x, qt, mesh, compute_dtype
+                )
+            except (ImportError, NotImplementedError):
+                pass
     if dispatch.use_pallas(fam) and qt.qtype in _PALLAS_QTYPES:
         try:
             from ipex_llm_tpu.ops.pallas import qmatmul as pallas_qmatmul

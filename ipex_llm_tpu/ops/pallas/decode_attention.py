@@ -41,6 +41,7 @@ from ipex_llm_tpu.ops.pallas._compat import (
     interpret as _interpret,
     round_up as _round_up,
 )
+from ipex_llm_tpu.parallel.compat import shard_map as _shard_map
 
 
 def _kernel(len_ref, start_ref, won_ref, q_ref, k_ref, v_ref, o_ref,
@@ -234,7 +235,7 @@ def decode_sdpa_sharded(q, k_raw, v_raw, mesh, **kwargs):
 
     q_spec = P(None, None, "tp", None)
     kv_spec = P(None, "tp", None, None)
-    return jax.shard_map(
+    return _shard_map(
         run, mesh=mesh, axis_names={"tp"},
         in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
         check_vma=False,

@@ -31,6 +31,7 @@ from ipex_llm_tpu.ops.pallas._compat import (
     interpret as _interpret,
     round_up as _round_up,
 )
+from ipex_llm_tpu.parallel.compat import shard_map as _shard_map
 
 
 def _kernel(qpos_ref, kvlen_ref, kvstart_ref, won_ref, q_ref, k_ref, v_ref,
@@ -240,7 +241,7 @@ def flash_sdpa_sharded(q, k, v, mesh, *, q_positions=None, kv_len=None,
 
     hspec = P(None, None, "tp", None)
     rep2, rep1 = P(None, None), P(None)
-    return jax.shard_map(
+    return _shard_map(
         run, mesh=mesh, axis_names={"tp"},
         in_specs=(hspec, hspec, hspec, rep2, rep1, rep1, rep1),
         out_specs=hspec, check_vma=False,

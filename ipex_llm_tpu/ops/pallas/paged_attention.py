@@ -36,6 +36,7 @@ from ipex_llm_tpu.ops.pallas._compat import (
     interpret as _interpret,
     round_up as _round_up,
 )
+from ipex_llm_tpu.parallel.compat import shard_map as _shard_map
 
 
 def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -225,7 +226,7 @@ def paged_prefill_sdpa_sharded(q, k_pool, v_pool, tables, kv_len, mesh, *,
 
     q_spec = P(None, None, "tp", None)
     pool_spec = P(None, "tp", None, None)
-    return jax.shard_map(
+    return _shard_map(
         run, mesh=mesh, axis_names={"tp"},
         in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
         out_specs=q_spec,
@@ -274,7 +275,7 @@ def paged_decode_sdpa_sharded(q, k_pool, v_pool, tables, kv_len, mesh, *,
 
     q_spec = P(None, None, "tp", None)
     pool_spec = P(None, "tp", None, None)
-    return jax.shard_map(
+    return _shard_map(
         run, mesh=mesh, axis_names={"tp"},
         in_specs=(q_spec, pool_spec, pool_spec, P(None, None), P(None)),
         out_specs=q_spec,

@@ -39,6 +39,7 @@ from ipex_llm_tpu.ops.pallas._compat import (
     interpret as _interpret,
     round_up as _round_up,
 )
+from ipex_llm_tpu.parallel.compat import shard_map as _shard_map
 
 from ipex_llm_tpu.quantize import numerics
 from ipex_llm_tpu.quantize.core import QTensor
@@ -307,7 +308,7 @@ def qmatmul_pallas_sharded(x: jnp.ndarray, qt: QTensor, mesh,
 
     in_specs = [x_spec, w_spec, w_spec] + ([w_spec] if has_zeros else [])
     args = [x, qt.data, qt.scales] + ([qt.zeros] if has_zeros else [])
-    return jax.shard_map(
+    return _shard_map(
         run, mesh=mesh, axis_names={"tp"},
         in_specs=tuple(in_specs), out_specs=out_spec, check_vma=False,
     )(*args)

@@ -1,0 +1,66 @@
+"""Pinned-API ``shard_map`` shim for jax 0.4.37.
+
+Every manual-mesh program in this repo (the Pallas kernel wrappers in
+ops/pallas/*, the GPipe pipeline in parallel/pipeline.py, the manual-TP
+fused serving tick in parallel/manual.py) is written against the MODERN
+``jax.shard_map`` surface::
+
+    jax.shard_map(f, mesh=mesh, in_specs=..., out_specs=...,
+                  axis_names={"tp"}, check_vma=False)
+
+jax 0.4.37 does not export ``jax.shard_map`` — the functionality lives at
+``jax.experimental.shard_map.shard_map`` with the OLD parameter names:
+``axis_names`` (the manual axes) is expressed as its complement ``auto``
+(the axes left to GSPMD), and ``check_vma`` is ``check_rep``.  This module
+is the ONE translation point (the documented jax-0.4.37 fallback): call
+sites import :func:`shard_map` from here and stay written against the
+pinned modern API, so when the toolchain moves to a jax that ships
+``jax.shard_map`` natively the shim collapses to a passthrough and nothing
+else changes.
+
+The shim deliberately supports only the subset this repo uses — mesh /
+in_specs / out_specs as keywords, ``axis_names`` as a set of manual axis
+names, ``check_vma`` — and raises on anything else rather than silently
+translating it wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = ["shard_map", "HAS_NATIVE_SHARD_MAP"]
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names: Any = None,
+              check_vma: bool = True):
+    """``jax.shard_map`` with the modern keyword surface on jax 0.4.37.
+
+    ``axis_names``: the MANUAL mesh axes (``None`` = all of them, fully
+    manual).  Axes not named stay under GSPMD inside the region
+    (partial-auto), exactly the modern semantics.  ``check_vma`` maps to
+    the legacy ``check_rep`` replication check.
+    """
+    if HAS_NATIVE_SHARD_MAP:  # pragma: no cover - future jax
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names,
+                             check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    manual = (frozenset(mesh.axis_names) if axis_names is None
+              else frozenset(axis_names))
+    unknown = manual - frozenset(mesh.axis_names)
+    if unknown:
+        raise ValueError(
+            f"axis_names {sorted(unknown)} not in mesh axes "
+            f"{mesh.axis_names}")
+    auto = frozenset(mesh.axis_names) - manual
+    # the legacy replication check predates partial-auto and rejects auto
+    # regions outright; a caller asking for check_vma with auto axes gets
+    # the closest legal thing (no check) rather than a crash
+    check_rep = bool(check_vma) and not auto
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_rep, auto=auto)

@@ -35,6 +35,26 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ipex_llm_tpu.models.config import ModelConfig
+from ipex_llm_tpu.ops import collectives
+from ipex_llm_tpu.parallel.compat import shard_map as _shard_map
+
+
+def _reject_composed_mesh(mesh, entry: str):
+    """jax 0.4.37 env limit: ``ppermute`` inside a partial-auto shard_map
+    region on a mesh with a second >1 axis CHECK-CRASHES the XLA SPMD
+    partitioner (spmd_partitioner.cc ``IsManualSubgroup`` — a process
+    ABORT, not an exception; tests/test_serving_tp.py holds the
+    characterization).  The GPipe entries therefore accept pure-pp meshes
+    only and refuse composed ones up front with a catchable error; the
+    serving engine routes composed meshes through the fused GSPMD tick
+    instead."""
+    others = {a: n for a, n in mesh.shape.items() if a != "pp" and n > 1}
+    if others:
+        raise ValueError(
+            f"{entry} needs a pure-pp mesh: composed axes {others} would "
+            "abort the jax 0.4.37 SPMD partitioner (ppermute in a "
+            "partial-auto region) — serve composed meshes through the "
+            "GSPMD tick instead")
 
 
 def _stage_specs(tree) -> object:
@@ -71,6 +91,7 @@ def pipeline_forward(
         raise NotImplementedError(
             "dense-prefix MoE models don't pipeline yet (two stacks)"
         )
+    _reject_composed_mesh(mesh, "pipeline_forward")
     pp = mesh.shape["pp"]
     b, t = tokens.shape
     if b % n_micro:
@@ -97,9 +118,15 @@ def pipeline_forward(
         [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
     )
 
-    def stages(layer_tree, flags, mb_all):
-        """Runs on every pp stage with its local L/pp layer chunk."""
-        stage = jax.lax.axis_index("pp")
+    def stages(layer_tree, flags, mb_all, stage_ids):
+        """Runs on every pp stage with its local L/pp layer chunk.
+
+        ``stage_ids`` is a pp-sharded iota whose local element IS the
+        stage index — jax 0.4.37's SPMD pipeline cannot lower
+        ``axis_index`` inside a partial-auto region (the PartitionId
+        instruction is rejected when auto axes are present), so the
+        stage id arrives as data instead of an instruction."""
+        stage = stage_ids[0]
         n_local = cfg.num_layers // pp
         # scratch cache for the local chunk (cacheless full-seq attention)
         cache = KVCache.init(n_local, bm, t, cfg.num_kv_heads, cfg.head_dim,
@@ -144,23 +171,25 @@ def pipeline_forward(
             tick, (state0, outs0), jnp.arange(n_micro + pp - 1)
         )
         # only the last stage holds real (non-zero) outputs: the psum is a
-        # broadcast of its rows to every stage.  f32 for the collective:
-        # XLA:CPU's AllReducePromotion pass check-fails cloning a bf16
-        # all-reduce inside a partial-auto region (tp x pp), and f32 also
-        # avoids precision loss in the broadcast.
-        return jax.lax.psum(outs.astype(jnp.float32), "pp").astype(outs.dtype)
+        # broadcast of its rows to every stage.  The collective family
+        # (ops/collectives.py) owns the payload story — f32 accumulation,
+        # and the XLA:CPU AllReducePromotion crash handled inside the
+        # family instead of a blanket promotion at every call site.
+        return collectives.psum_exact(outs, "pp")
 
-    out = jax.shard_map(
+    out = _shard_map(
         stages,
         mesh=mesh,
-        in_specs=(_stage_specs(params["layers"]), P("pp"), P()),
+        in_specs=(_stage_specs(params["layers"]), P("pp"), P(),
+                  P("pp")),
         out_specs=P(),
         check_vma=False,
-        # PARTIAL-AUTO: only pp is manual; a tp (or dp) axis on the same
-        # mesh stays under GSPMD, which shards each stage's matmuls and
-        # inserts the tp psums inside the manual region (tp x pp composed)
+        # pp manual, the (size-1, by the composed-mesh guard above) other
+        # axes nominally auto — composed tp x pp is rejected up front,
+        # see _reject_composed_mesh
         axis_names={"pp"},
-    )(params["layers"], sliding_flags, mbs)
+    )(params["layers"], sliding_flags, mbs,
+      jnp.arange(pp, dtype=jnp.int32))
 
     return logits_tail(cfg, params, out.reshape(b, t, -1))
 
@@ -213,6 +242,7 @@ def pp_decode_step(
 
     if "layers_dense" in params:
         raise NotImplementedError("dense-prefix MoE models don't pipeline yet")
+    _reject_composed_mesh(mesh, "pp_decode_step")
     pp = mesh.shape["pp"]
     wide = toks.ndim == 2
     tokens = toks if wide else toks[:, None]     # [R, T]
@@ -241,8 +271,8 @@ def pp_decode_step(
         [cfg.layer_is_sliding(l) for l in range(cfg.num_layers)], dtype=bool
     )
 
-    def stages(layer_tree, flags, k_loc, v_loc, aux):
-        stage = jax.lax.axis_index("pp")
+    def stages(layer_tree, flags, k_loc, v_loc, aux, stage_ids):
+        stage = stage_ids[0]   # data, not axis_index: see pipeline_forward
 
         def pick(name, mi):
             a = aux.get(name)
@@ -286,25 +316,26 @@ def pp_decode_step(
             tick, (jnp.zeros_like(aux["x"][0]), k_loc, v_loc, outs0),
             jnp.arange(n_micro + pp - 1),
         )
-        # f32 psum: see pipeline_forward (CPU AllReducePromotion crash)
-        return (jax.lax.psum(outs.astype(jnp.float32), "pp")
-                .astype(outs.dtype), k_loc, v_loc)
+        # exact-family psum: see pipeline_forward (the collective family
+        # owns the CPU AllReducePromotion workaround)
+        return collectives.psum_exact(outs, "pp"), k_loc, v_loc
 
     pool_spec = P("pp", None, None, None, None)
     aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
-    out, k_new, v_new = jax.shard_map(
+    out, k_new, v_new = _shard_map(
         stages,
         mesh=mesh,
         in_specs=(_stage_specs(params["layers"]), P("pp"), pool_spec,
-                  pool_spec, aux_specs),
+                  pool_spec, aux_specs, P("pp")),
         out_specs=(P(), pool_spec, pool_spec),
         check_vma=False,
-        # PARTIAL-AUTO over pp only: on a tp x pp serving mesh the stage
-        # bodies' matmuls stay under GSPMD, which tp-shards them and
-        # inserts the AutoTP psums — pipelined decode composes with TP
-        # (VERDICT r4 next #7; the reference has no TP+PP serving peer)
+        # pp manual; composed tp x pp is rejected up front (the jax
+        # 0.4.37 partitioner aborts on ppermute in partial-auto regions
+        # with a >1 auto axis — see _reject_composed_mesh), so the
+        # engine serves tp x pp meshes through the fused GSPMD tick
         axis_names={"pp"},
-    )(params["layers"], sliding_flags, cache.k, cache.v, aux)
+    )(params["layers"], sliding_flags, cache.k, cache.v, aux,
+      jnp.arange(pp, dtype=jnp.int32))
 
     logits = logits_tail(cfg, params, out.reshape(r, t_w, -1))
     if not wide:

@@ -505,6 +505,26 @@ class OpenAIServer:
         # saved bytes to the KV pool (more pages, more concurrent rows at
         # the same cap; see bench_weight_qtype)
         body["weights"] = self.engine.weight_stats()
+        # multi-chip routing: which tick serves this mesh (the fully-
+        # manual shard_map tick vs the per-op GSPMD fallback, with the
+        # recorded reason), the collective wire family, and the per-shard
+        # KV split — the first thing to read when a tp engine is slower
+        # than expected (a silent GSPMD fallback looks like a perf bug)
+        if self.engine.mesh is not None:
+            eng = self.engine
+            # per-shard bytes off the REAL placement (shard_paged_cache
+            # head-shards the pool on the GSPMD path too, when heads
+            # divide — dividing by tp only under the manual tick would
+            # overreport fallback engines by tp)
+            shard_bytes = (eng.cache.k.addressable_shards[0].data.nbytes
+                           + eng.cache.v.addressable_shards[0].data.nbytes)
+            body["parallel"] = {
+                "mesh": dict(eng.mesh.shape),
+                "tp_manual": eng._tp_manual,
+                "tp_fallback_reason": eng._tp_fallback_reason,
+                "collective_qtype": eng._collective_qtype,
+                "kv_pool_bytes_per_shard": int(shard_bytes),
+            }
         # fault-domain observability: admission backlog vs the bound (what
         # a 429 means), per-request failures isolated by bisection,
         # transient step retries, load-shed and deadline-expired counts
@@ -988,6 +1008,15 @@ def main(argv=None):
     ap.add_argument("--max-seq-len", type=int, default=4096)
     ap.add_argument("--asr-model", default=None,
                     help="whisper checkpoint enabling /v1/audio/transcriptions")
+    ap.add_argument("--collective-qtype", default=None,
+                    choices=("bf16", "e5m2", "int8"), metavar="FAMILY",
+                    help="AllReduce wire family for the manual-mesh tp "
+                         "tick (ops/collectives.py): bf16 = exact (f32 "
+                         "accumulate, tp2 bit-identical to single-chip); "
+                         "e5m2/int8 = EQuARX-style quantized payloads, "
+                         "bounded error for less ICI traffic.  Default: "
+                         "the IPEX_LLM_TPU_COLLECTIVE_QTYPE env, else "
+                         "bf16")
     ap.add_argument("--tensor-parallel-size", type=int, default=1,
                     help="serve under a tp mesh of this many chips")
     ap.add_argument("--spec-k", "--speculative", type=int, default=0,
@@ -1094,7 +1123,8 @@ def main(argv=None):
                      max_queue=args.max_queue,
                      request_deadline_s=args.request_deadline,
                      max_step_retries=args.max_step_retries,
-                     trace_requests=args.trace),
+                     trace_requests=args.trace,
+                     collective_qtype=args.collective_qtype),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
         drain_timeout_s=args.drain_timeout,

@@ -206,6 +206,17 @@ class EngineConfig:
     trace_requests: bool = False
     trace_buffer: int = 256     # traces retained (LRU); spans/trace capped
     flight_ring: int = 256      # tick records the flight recorder retains
+    # multi-chip collective wire family (ops/collectives.py, the EQuARX
+    # axis): what the manual-mesh tick's row-parallel AllReduces carry.
+    # "bf16" = the exact family (f32 accumulation; tp2 output is
+    # bit-stable against tp1 — the bit-identity gate's family); "e5m2" /
+    # "int8" = quantized payloads, bounded error for less ICI traffic —
+    # decode's real multi-chip bottleneck.  None = resolve through
+    # collectives.resolve_qtype (the IPEX_LLM_TPU_COLLECTIVE_QTYPE env,
+    # else the exact default) — an explicit value here always wins.
+    # Ignored off-mesh and on the GSPMD fallback path (XLA owns those
+    # collectives).
+    collective_qtype: str | None = None
 
     @property
     def n_pages(self) -> int:
@@ -869,15 +880,99 @@ def _mixed_prefill_fn(cfg: ModelConfig, params, cache, tokens, base_lens,
 # toks — the host rebinds _dev["hist"] to the returned buffer — so it
 # donates by name.  The prefill block's arrays and ``spec_ks`` are fresh
 # per-tick uploads, too small to matter.  JP101 locks both directions.
+def _tick_body(cfg: ModelConfig, params, cache, toks, row_lens,
+               active, temps, top_ps, key, seeds, steps, top_ks,
+               eos, remain, prefill=None, horizon: int = 1,
+               with_decode: bool = True, hist=None, spec_ks=None,
+               spec_k: int = 0, spec_ngram: int = 3):
+    """The fused tick BODY (see ``_ragged_tick_fn`` for the contract):
+    traced either directly under GSPMD dispatch, or — the manual-mesh
+    serving form — once per shard inside ``parallel/manual.tp_tick``'s
+    fully-manual shard_map region with a shard-local cfg/params/pool."""
+    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
+
+    r = toks.shape[0]
+    first_t = first_lp = None
+    if prefill is not None:
+        (p_tokens, p_tables, p_base, p_nvalid, p_emit, p_canjoin,
+         p_rowmap) = prefill
+        w = p_tokens.shape[1]
+        row_cache = replace(cache, tables=p_tables)
+        pos = p_base[:, None] + jnp.arange(w)[None, :]
+        logits, row_cache = decoder_forward(
+            cfg, params, p_tokens, row_cache, pos,
+            slot_offsets=p_base,
+            gather_positions=jnp.maximum(p_nvalid - 1, 0),
+            chunk_lens=p_nvalid,
+        )
+        cache = replace(cache, k=row_cache.k, v=row_cache.v)
+        key, sub = jax.random.split(key)
+        first_t, first_lp = sample_rows_with_logprobs(
+            logits, temps[p_rowmap], top_ps[p_rowmap], sub,
+            seeds=seeds[p_rowmap], steps=jnp.zeros_like(p_nvalid),
+            top_ks=top_ks[p_rowmap], active=p_emit)
+        # merge the wave into the decode state (pad slots drop):
+        # lengths advance for EVERY prefill row, completing rows join
+        # with their first token pre-published — the on-device form
+        # of the epoch upload the chained path paid here
+        new_len = p_base + p_nvalid
+        row_lens = row_lens.at[p_rowmap].set(new_len, mode="drop")
+        hit_eos = (first_t[:, None] == eos[p_rowmap]).any(axis=1)
+        rem_after = remain[p_rowmap] - 1
+        join = p_emit & p_canjoin & ~hit_eos & (rem_after > 0)
+        toks = toks.at[p_rowmap].set(
+            jnp.where(p_emit, first_t, toks[p_rowmap]), mode="drop")
+        steps = steps.at[p_rowmap].set(
+            jnp.where(p_emit, 1, steps[p_rowmap]), mode="drop")
+        remain = remain.at[p_rowmap].set(
+            jnp.where(p_emit, rem_after, remain[p_rowmap]),
+            mode="drop")
+        active = active.at[p_rowmap].set(
+            jnp.where(p_emit, join, active[p_rowmap]), mode="drop")
+        if spec_k > 0:
+            # a completing row's history gains its first token ON
+            # DEVICE (the prompt itself landed with the admission
+            # epoch upload), so the decode stage below can already
+            # draft for it; pad slots and non-emitting rows drop
+            hpos = jnp.where(p_emit, new_len, hist.shape[1])
+            hist = hist.at[p_rowmap, hpos].set(first_t, mode="drop")
+    if with_decode and spec_k > 0:
+        (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
+         steps, remain, key, take_block, hist, prop,
+         acc) = _decode_horizon_loop(
+            cfg, params, cache, toks, row_lens, active, temps,
+            top_ps, key, seeds, steps, top_ks, eos, remain, horizon,
+            hist=hist, spec_ks=spec_ks, spec_k=spec_k,
+            spec_ngram=spec_ngram)
+    elif with_decode:
+        (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
+         steps, remain, key) = _decode_horizon_loop(
+            cfg, params, cache, toks, row_lens, active, temps,
+            top_ps, key, seeds, steps, top_ks, eos, remain, horizon)
+    else:
+        tok_block = jnp.zeros((r, horizon), jnp.int32)
+        lp_block = jnp.zeros((r, horizon), jnp.float32)
+        n_exec = jnp.asarray(0, jnp.int32)
+    if spec_k > 0:
+        return (first_t, first_lp, tok_block, lp_block, n_exec, cache,
+                toks, row_lens, active, steps, remain, key, take_block,
+                hist, prop, acc)
+    return (first_t, first_lp, tok_block, lp_block, n_exec, cache, toks,
+            row_lens, active, steps, remain, key)
+
+
 @partial(jax.jit,
          static_argnames=("cfg", "horizon", "with_decode", "spec_k",
-                          "spec_ngram", "mesh"),
+                          "spec_ngram", "mesh", "tp_manual",
+                          "collective_qtype"),
          donate_argnums=(2, 3, 4, 5, 10, 13), donate_argnames=("hist",))
 def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
                     active, temps, top_ps, key, seeds, steps, top_ks,
                     eos, remain, prefill=None, horizon: int = 1,
                     with_decode: bool = True, hist=None, spec_ks=None,
-                    spec_k: int = 0, spec_ngram: int = 3, mesh=None):
+                    spec_k: int = 0, spec_ngram: int = 3, mesh=None,
+                    tp_manual: bool = False,
+                    collective_qtype: str = "bf16"):
     """ONE device program per engine tick, whatever the admission mix —
     the ragged-paged-attention superkernel tick (ROADMAP item 1; the
     JP106 gate counts exactly this entry).
@@ -925,77 +1020,23 @@ def _ragged_tick_fn(cfg: ModelConfig, params, cache, toks, row_lens,
     with [R, H, spec_k+1] token/logprob blocks.
     """
     from ipex_llm_tpu.ops import dispatch
-    from ipex_llm_tpu.ops.sampling import sample_rows_with_logprobs
 
-    r = toks.shape[0]
-    first_t = first_lp = None
+    if tp_manual:
+        from ipex_llm_tpu.parallel.manual import tp_tick
+
+        return tp_tick(
+            _tick_body, cfg, mesh, collective_qtype, params, cache,
+            (toks, row_lens, active, temps, top_ps, key, seeds, steps,
+             top_ks, eos, remain),
+            prefill=prefill, horizon=horizon, with_decode=with_decode,
+            hist=hist, spec_ks=spec_ks, spec_k=spec_k,
+            spec_ngram=spec_ngram)
     with dispatch.spmd(mesh):
-        if prefill is not None:
-            (p_tokens, p_tables, p_base, p_nvalid, p_emit, p_canjoin,
-             p_rowmap) = prefill
-            w = p_tokens.shape[1]
-            row_cache = replace(cache, tables=p_tables)
-            pos = p_base[:, None] + jnp.arange(w)[None, :]
-            logits, row_cache = decoder_forward(
-                cfg, params, p_tokens, row_cache, pos,
-                slot_offsets=p_base,
-                gather_positions=jnp.maximum(p_nvalid - 1, 0),
-                chunk_lens=p_nvalid,
-            )
-            cache = replace(cache, k=row_cache.k, v=row_cache.v)
-            key, sub = jax.random.split(key)
-            first_t, first_lp = sample_rows_with_logprobs(
-                logits, temps[p_rowmap], top_ps[p_rowmap], sub,
-                seeds=seeds[p_rowmap], steps=jnp.zeros_like(p_nvalid),
-                top_ks=top_ks[p_rowmap], active=p_emit)
-            # merge the wave into the decode state (pad slots drop):
-            # lengths advance for EVERY prefill row, completing rows join
-            # with their first token pre-published — the on-device form
-            # of the epoch upload the chained path paid here
-            new_len = p_base + p_nvalid
-            row_lens = row_lens.at[p_rowmap].set(new_len, mode="drop")
-            hit_eos = (first_t[:, None] == eos[p_rowmap]).any(axis=1)
-            rem_after = remain[p_rowmap] - 1
-            join = p_emit & p_canjoin & ~hit_eos & (rem_after > 0)
-            toks = toks.at[p_rowmap].set(
-                jnp.where(p_emit, first_t, toks[p_rowmap]), mode="drop")
-            steps = steps.at[p_rowmap].set(
-                jnp.where(p_emit, 1, steps[p_rowmap]), mode="drop")
-            remain = remain.at[p_rowmap].set(
-                jnp.where(p_emit, rem_after, remain[p_rowmap]),
-                mode="drop")
-            active = active.at[p_rowmap].set(
-                jnp.where(p_emit, join, active[p_rowmap]), mode="drop")
-            if spec_k > 0:
-                # a completing row's history gains its first token ON
-                # DEVICE (the prompt itself landed with the admission
-                # epoch upload), so the decode stage below can already
-                # draft for it; pad slots and non-emitting rows drop
-                hpos = jnp.where(p_emit, new_len, hist.shape[1])
-                hist = hist.at[p_rowmap, hpos].set(first_t, mode="drop")
-        if with_decode and spec_k > 0:
-            (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
-             steps, remain, key, take_block, hist, prop,
-             acc) = _decode_horizon_loop(
-                cfg, params, cache, toks, row_lens, active, temps,
-                top_ps, key, seeds, steps, top_ks, eos, remain, horizon,
-                hist=hist, spec_ks=spec_ks, spec_k=spec_k,
-                spec_ngram=spec_ngram)
-        elif with_decode:
-            (tok_block, lp_block, n_exec, cache, toks, row_lens, active,
-             steps, remain, key) = _decode_horizon_loop(
-                cfg, params, cache, toks, row_lens, active, temps,
-                top_ps, key, seeds, steps, top_ks, eos, remain, horizon)
-        else:
-            tok_block = jnp.zeros((r, horizon), jnp.int32)
-            lp_block = jnp.zeros((r, horizon), jnp.float32)
-            n_exec = jnp.asarray(0, jnp.int32)
-    if spec_k > 0:
-        return (first_t, first_lp, tok_block, lp_block, n_exec, cache,
-                toks, row_lens, active, steps, remain, key, take_block,
-                hist, prop, acc)
-    return (first_t, first_lp, tok_block, lp_block, n_exec, cache, toks,
-            row_lens, active, steps, remain, key)
+        return _tick_body(
+            cfg, params, cache, toks, row_lens, active, temps, top_ps,
+            key, seeds, steps, top_ks, eos, remain, prefill=prefill,
+            horizon=horizon, with_decode=with_decode, hist=hist,
+            spec_ks=spec_ks, spec_k=spec_k, spec_ngram=spec_ngram)
 
 
 class ServingEngine:
@@ -1156,25 +1197,63 @@ class ServingEngine:
             cfg.num_kv_heads, self.ec.page_size, cfg.head_dim,
             v_head_dim=cfg.v_dim, storage=self.ec.kv_storage,
         )
+        # multi-chip serving: on a PURE-tp mesh whose shapes divide, the
+        # engine takes the MANUAL tick — the whole fused tick inside one
+        # fully-manual shard_map region (parallel/manual.py), per-shard
+        # pools and explicit quantized collectives, GSPMD out of the loop.
+        # Anything the manual layout does not cover (composed meshes, MoE,
+        # MLA, non-dividing heads, the sequential oracle engine) falls
+        # back to the per-op GSPMD path, with the reason recorded for
+        # /health-side debugging.
+        self._tp_manual = False
+        self._tp_fallback_reason: str | None = None
+        from ipex_llm_tpu.ops import collectives
+
+        # config wins, then the IPEX_LLM_TPU_COLLECTIVE_QTYPE env, then
+        # the exact family; raises on an unknown family name
+        self._collective_qtype = collectives.resolve_qtype(
+            self.ec.collective_qtype)
         if self.mesh is not None:
+            from ipex_llm_tpu.parallel import manual
             from ipex_llm_tpu.parallel.shard import (shard_paged_cache,
                                                      shard_params)
 
-            # re-placing already-sharded params is an idempotent device_put
-            params = shard_params(params, self.mesh)
+            budget = (self.ec.prefill_bucket
+                      if self.ec.step_token_budget is None
+                      else int(self.ec.step_token_budget))
+            reason = manual.ineligible_reason(cfg, params, self.mesh,
+                                              budget)
+            if reason is None:
+                params = manual.shard_params_manual(params, cfg,
+                                                    self.mesh)
+                self._tp_manual = True
+            else:
+                # re-placing already-sharded params is an idempotent
+                # device_put
+                params = shard_params(params, self.mesh)
+                self._tp_fallback_reason = reason
             cache = shard_paged_cache(cache, self.mesh)
         self.params = params
         self.cache = cache
         # pipelined decode (PPModelWorker peer): GPipe request groups over
-        # the pp axis; a tp axis on the same mesh composes via partial-auto
-        # shard_map (GSPMD tp-shards each stage's matmuls inside the manual
-        # region), and speculative verify steps ride the pipeline's wide
-        # (T=k+1) form.  What it can't serve (MoE dual stack, non-dividing
-        # shapes) falls back to GSPMD stage-sequential decode, which is
-        # correct but leaves (pp-1)/pp chips idle.
+        # the pp axis, on PURE-pp meshes; speculative verify steps ride
+        # the pipeline's wide (T=k+1) form.  What it can't serve (MoE
+        # dual stack, non-dividing shapes, composed meshes) falls back to
+        # GSPMD decode, which is correct but leaves chips idle.
+        # COMPOSED-MESH LIMIT (jax 0.4.37): ppermute inside a partial-auto
+        # shard_map region on a mesh with a second >1 axis CHECK-CRASHES
+        # the XLA SPMD partitioner (spmd_partitioner.cc
+        # IsManualSubgroup) — an abort, not an exception — so a tp x pp
+        # mesh must not take the GPipe path; it serves through the fused
+        # GSPMD tick instead (tp=2 compositions are the characterized-
+        # safe grid, tests/test_parallel.py).
         pp = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
+        composed = (self.mesh is not None
+                    and any(n > 1 for a, n in self.mesh.shape.items()
+                            if a != "pp"))
         self._pp_mode = (
             pp > 1
+            and not composed
             and cfg.num_layers % pp == 0
             and r % pp == 0
             and "layers_dense" not in params
@@ -3177,7 +3256,8 @@ class ServingEngine:
                 prefill=prefill, horizon=1, with_decode=True,
                 hist=dev["hist"], spec_ks=h2d(spec_ks),
                 spec_k=self.ec.spec_k, spec_ngram=self.ec.spec_ngram,
-                mesh=self.mesh)
+                mesh=self.mesh, tp_manual=self._tp_manual,
+                collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
         else:
             (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
@@ -3188,7 +3268,9 @@ class ServingEngine:
                 dev["top_ps"], self.key, dev["seeds"], dev["steps"],
                 dev["top_ks"], dev["eos"], dev["remain"],
                 prefill=prefill, horizon=1,
-                with_decode=with_decode, mesh=self.mesh)
+                with_decode=with_decode, mesh=self.mesh,
+                tp_manual=self._tp_manual,
+                collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
         # advance bookkeeping; completed prompts run the shared
         # completion path (_finish_prompt) once their token arrives
@@ -3344,7 +3426,9 @@ class ServingEngine:
                 dev["top_ks"], dev["eos"], dev["remain"],
                 prefill=None, horizon=h, hist=dev["hist"],
                 spec_ks=h2d(spec_ks), spec_k=self.ec.spec_k,
-                spec_ngram=self.ec.spec_ngram, mesh=self.mesh)
+                spec_ngram=self.ec.spec_ngram, mesh=self.mesh,
+                tp_manual=self._tp_manual,
+                collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
         else:
             # the steady-state tick is the SAME single jitted entry the
@@ -3360,7 +3444,9 @@ class ServingEngine:
                 dev["row_lens"], dev["active"], dev["temps"],
                 dev["top_ps"], self.key, dev["seeds"], dev["steps"],
                 dev["top_ks"], dev["eos"], dev["remain"],
-                prefill=None, horizon=h, mesh=self.mesh)
+                prefill=None, horizon=h, mesh=self.mesh,
+                tp_manual=self._tp_manual,
+                collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
             # the returned cache owns the (donated) tables buffer now
         t0 = time.perf_counter()
