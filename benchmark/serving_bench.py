@@ -35,6 +35,23 @@ def _percentile(xs, p):
     return float(np.percentile(np.asarray(xs, np.float64), p)) if xs else 0.0
 
 
+def _perf_stamp(eng) -> dict:
+    """Device-time-observatory columns stamped into every engine-backed
+    serving_bench row (BENCH_r15+): ``mfu`` is the manifest-joined
+    model-flops-utilization over the engine's committed ticks
+    (serving/perfwatch.py — None when the manifest has no cost entry for
+    the served grid family), and ``compiles_warm`` is the recompile
+    sentinel's warm-path count — the gate expectation is == 0 after
+    warm-up, i.e. no measured window ever silently paid a shape-driven
+    recompile."""
+    perf = getattr(eng, "perf", None)
+    if perf is None:
+        return {"mfu": None, "compiles_warm": None}
+    return {"mfu": perf.mfu(),
+            "compiles_warm": perf.compiles["compiles_warm"],
+            "compiles_out_of_grid": perf.compiles["compiles_out_of_grid"]}
+
+
 def _warm(eng, prompts, n_out: int = 4):
     """Compile warm-up outside the timed window.  Callers pass DISTINCT
     prompt draws: reusing a measured prompt would register its pages in
@@ -127,6 +144,7 @@ def bench_level(cfg, params, engine_config, concurrency: int, n_in: int,
                 m.get("host_sync_s", 0.0) - m0.get("host_sync_s", 0.0), 6),
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
+            **_perf_stamp(eng),
         }
     finally:
         eng.stop()
@@ -200,6 +218,7 @@ def bench_tp_scaling(cfg, params, engine_config, tps=(1, 2, 4, 8),
                 "tick_dispatches": disp_max,
                 "completed": sum(1 for r in reqs
                                  if r.finish_reason in ("length", "stop")),
+                **_perf_stamp(eng),
             }
             if eng._tp_fallback_reason:
                 row["tp_fallback_reason"] = eng._tp_fallback_reason
@@ -307,6 +326,7 @@ def bench_kv_storage(cfg, params, engine_config, concurrency: int,
             "horizon_clamps": kv["horizon_clamped"] - kv0["horizon_clamped"],
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
+            **_perf_stamp(eng),
         }
     finally:
         eng.stop()
@@ -432,6 +452,7 @@ def bench_weight_qtype(cfg, params, engine_config, n_in: int, n_out: int,
                                       - kv0["alloc_fail_clamps"]),
                 "completed": sum(1 for r in reqs
                                  if r.finish_reason in ("length", "stop")),
+                **_perf_stamp(eng),
             })
         finally:
             eng.stop()
@@ -524,6 +545,7 @@ def bench_kv_spill(cfg, params, engine_config, concurrency: int,
             "spill_bytes_resident": kv.get("spill_bytes", 0),
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
+            **_perf_stamp(eng),
         }
     finally:
         eng.stop()
@@ -702,6 +724,7 @@ def bench_spec(cfg, params, engine_config, concurrency: int, n_out: int,
             "draft_accepted": acc_w,
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
+            **_perf_stamp(eng),
         }
     finally:
         eng.stop()
@@ -1355,6 +1378,7 @@ def bench_churn(cfg, params, engine_config, concurrency: int = 4,
             "tick_dispatches": _audited_tick_dispatches(),
             "completed": sum(
                 1 for r in reqs if r.finish_reason in ("length", "stop")),
+            **_perf_stamp(eng),
         }
         if fault_injector is not None:
             row.update({
@@ -1387,28 +1411,31 @@ def bench_observe(cfg, params, engine_config, concurrency: int = 4,
                   n_reqs: int = 8, n_out: int = 16,
                   prompt_lens=(24, 48, 72, 96), gap_s: float = 0.05,
                   reps: int = 3) -> dict:
-    """The observability price row (BENCH_r13+): the SAME churn workload
-    with request-lifecycle tracing OFF (the default engine — tracer is
-    None, every trace site one attribute check) vs ON (spans staged in
-    the transactional tick), median-of-``reps`` each.  The flight
-    recorder and latency histograms are always on in BOTH rows, so the
-    traced row prices exactly the span machinery.  Gate expectation:
-    ``overhead_pct`` < 3 on agg tok/s (the ISSUE 13 acceptance bound) —
-    a regression here means a trace site leaked host work into the tick.
-    """
+    """The observability price row (BENCH_r13+, perfwatch pair r15+):
+    the SAME churn workload with the whole observability stack OFF
+    (tracer None AND ``EngineConfig.perfwatch=False`` — no dispatch
+    windows, no sentinel, no attribution histograms) vs ON (spans staged
+    in the transactional tick + the device-time observatory attributing
+    every committed tick), median-of-``reps`` each.  The flight recorder
+    and base latency histograms are always on in BOTH rows, so the
+    traced+attributed row prices exactly the span machinery plus the
+    perfwatch windows.  Gate expectation: ``overhead_pct`` < 3 on agg
+    tok/s (the ISSUE 13 tracer bound, held through ISSUE 15's
+    attribution) — a regression here means an observability site leaked
+    host work into the tick."""
     from dataclasses import replace as _dc_replace
 
     rows = {}
-    for traced in (False, True):
+    for on in (False, True):
         runs = [bench_churn(cfg, params,
                             _dc_replace(engine_config,
-                                        trace_requests=traced),
+                                        trace_requests=on, perfwatch=on),
                             concurrency=concurrency, n_reqs=n_reqs,
                             n_out=n_out, prompt_lens=prompt_lens,
                             gap_s=gap_s, seed=3 + rep)
                 for rep in range(reps)]
         runs.sort(key=lambda r: r["agg_tok_s"])
-        rows[traced] = runs[len(runs) // 2]
+        rows[on] = runs[len(runs) // 2]
     plain, traced = rows[False], rows[True]
     base = plain["agg_tok_s"]
     return {
@@ -1420,6 +1447,10 @@ def bench_observe(cfg, params, engine_config, concurrency: int = 4,
         "agg_tok_s_traced": traced["agg_tok_s"],
         "ttft_p95_s_plain": plain["ttft_p95_s"],
         "ttft_p95_s_traced": traced["ttft_p95_s"],
+        # the traced+attributed leg's observatory columns: the sentinel
+        # must stay quiet (compiles_warm == 0) while attribution runs
+        "mfu": traced.get("mfu"),
+        "compiles_warm": traced.get("compiles_warm"),
         "overhead_pct": (round(100.0 * (base - traced["agg_tok_s"])
                                / base, 2) if base else 0.0),
     }

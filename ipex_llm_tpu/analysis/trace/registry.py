@@ -104,17 +104,38 @@ def audit_model(wq: str = "bf16"):
     return _audit_model(wq)
 
 
-@lru_cache(maxsize=4)
-def _audit_model(wq: str):
-    from ipex_llm_tpu.models.random_init import llama_config, random_params
+def audit_cfg(wq: str = "bf16"):
+    """The audit model's ModelConfig ALONE — no param tree, no random
+    init.  Split out of :func:`audit_model` so runtime consumers (the
+    perfwatch MFU join needs the audit dims to scale the manifest's
+    cost_analysis to the serving model) can read the audit shape without
+    paying the quantize/random-params build."""
+    from ipex_llm_tpu.models.random_init import llama_config
 
     wide = wq != "bf16"
-    cfg = llama_config(hidden_size=128 if wide else 32,
-                       intermediate_size=256 if wide else 64, num_layers=2,
-                       num_heads=16 if wide else 4, num_kv_heads=2,
-                       head_dim=8, vocab_size=97,
-                       max_position_embeddings=256)
+    return llama_config(hidden_size=128 if wide else 32,
+                        intermediate_size=256 if wide else 64, num_layers=2,
+                        num_heads=16 if wide else 4, num_kv_heads=2,
+                        head_dim=8, vocab_size=97,
+                        max_position_embeddings=256)
+
+
+@lru_cache(maxsize=4)
+def _audit_model(wq: str):
+    from ipex_llm_tpu.models.random_init import random_params
+
+    cfg = audit_cfg(wq)
     return cfg, _sds(random_params(cfg, qtype=wq, seed=0))
+
+
+def audit_cfg_tp():
+    """The manual-TP audit model's ModelConfig alone (see
+    :func:`audit_cfg`)."""
+    from ipex_llm_tpu.models.random_init import llama_config
+
+    return llama_config(hidden_size=32, intermediate_size=64, num_layers=2,
+                        num_heads=8, num_kv_heads=8, head_dim=8,
+                        vocab_size=96, max_position_embeddings=256)
 
 
 @lru_cache(maxsize=1)
@@ -124,11 +145,9 @@ def audit_model_tp():
     contraction, the vocab — divides by 8, so one model lowers the
     sharded tick at tp in {1, 2, 4, 8} on the audit's 8 virtual CPU
     devices."""
-    from ipex_llm_tpu.models.random_init import llama_config, random_params
+    from ipex_llm_tpu.models.random_init import random_params
 
-    cfg = llama_config(hidden_size=32, intermediate_size=64, num_layers=2,
-                       num_heads=8, num_kv_heads=8, head_dim=8,
-                       vocab_size=96, max_position_embeddings=256)
+    cfg = audit_cfg_tp()
     return cfg, _sds(random_params(cfg, qtype="bf16", seed=0))
 
 
@@ -424,7 +443,12 @@ def real_registry() -> tuple[ProgramSpec, ...]:
                           wd=(False,), kv=kv_axis)
                   + _grid(rows=(4,), width=(0,), horizon=(1, 8),
                           spec=(4,), kv=kv_axis)
-                  + _grid(rows=(4,), width=(8,), horizon=(1,),
+                  # spec admission joiner at BOTH pow2 chunk widths: the
+                  # runtime recompile sentinel bounds the engine's pow2
+                  # width family by the widest point sampled here, so a
+                  # spec engine's wide admission wave must be priced or
+                  # it flags out-of-grid on its first burst
+                  + _grid(rows=(4,), width=(8, 128), horizon=(1,),
                           spec=(4,), kv=kv_axis)
                   # weight-qtype axis (EngineConfig.weight_qtype): the
                   # tick over stacked int4-packed weight planes — steady
@@ -434,8 +458,28 @@ def real_registry() -> tuple[ProgramSpec, ...]:
                   # params held (packed planes are never donated)
                   + _grid(rows=(4,), width=(0,), horizon=(1, 8),
                           wq=("sym_int4",), kv=kv_axis)
+                  # int4 admission joiner over BOTH pool storages: the
+                  # int4+fp8KV pairing is the fixed-HBM serving config
+                  # bench_weight_qtype gates, and the runtime sentinel
+                  # requires the structural (wq, kv) form to be locked
+                  # or every such admission wave flags out-of-grid.
+                  # Width stays at the 8 representative only: on the
+                  # widened int4 AUDIT model (hidden=128) a width-128
+                  # chunk's [p=2, 128, out] activation shape-collides
+                  # with the [L=2, 128, out] packed gate_up stack and
+                  # false-fires JP107 (the documented toy-model
+                  # ambiguity); the sentinel's width bound spans the wq
+                  # axis (perfwatch._mag_group), so real engines' wider
+                  # int4 waves are bounded by the bf16 rows' 128
                   + _grid(rows=(4,), width=(8,), horizon=(1,),
-                          wq=("sym_int4",), kv=("bf16",))
+                          wq=("sym_int4",), kv=kv_axis)
+                  # ...and the int4 pure-chunk form (wd=False): a
+                  # distinct jit variant with its own donation map, and
+                  # a structural form the sentinel must find locked —
+                  # an int4 engine's admission wave with nothing yet
+                  # decoding dispatches exactly this program
+                  + _grid(rows=(4,), width=(8,), horizon=(1,),
+                          wd=(False,), wq=("sym_int4",), kv=kv_axis)
                   # manual-mesh tp axis (parallel/manual.py): the whole
                   # tick inside ONE fully-manual shard_map region over a
                   # pure-tp CPU mesh, per-shard pools, explicit
@@ -469,7 +513,7 @@ def real_registry() -> tuple[ProgramSpec, ...]:
             # purpose
             held=frozenset({"params", "temps", "top_ps", "seeds",
                             "top_ks", "eos", "key"}),
-            max_lowerings=33,
+            max_lowerings=38,
         ),
         ProgramSpec(
             name="serving.decode_multi_step",

@@ -138,20 +138,31 @@ def ring(mesh):
 # IPEX_LLM_TPU_FORCE_PALLAS=1.  tpu: compiled kernels beat the fallback
 # on the same ladder points (the r01-r04 on-chip rounds); an op family
 # with no recorded pair falls back to the platform default.
-_BUILTIN_LADDER: dict[str, dict[str, dict[str, float]]] = {
+_BUILTIN_LADDER: dict[str, dict[str, dict[str, object]]] = {
+    # every row carries a "recorded" bench-round stamp (surfaced via
+    # ladder_provenance() in /health's dispatch block): the decision a
+    # row drives is only as fresh as the round that measured it, and a
+    # stale ladder should be VISIBLE, not silently trusted
     "cpu": {   # interpret-mode records, BENCH_r05 (+ the r06 ragged rows)
-        "decode_attn": {"pallas_us": 539.9, "xla_us": 267.7},
-        "decode_attn_fp8": {"pallas_us": 561.1, "xla_us": 493.2},
-        "paged_decode_attn": {"pallas_us": 540.0, "xla_us": 268.0},
-        "paged_decode_attn_fp8": {"pallas_us": 561.0, "xla_us": 493.0},
-        "ragged_attn": {"pallas_us": 540.0, "xla_us": 268.0},
-        "ragged_attn_fp8": {"pallas_us": 561.0, "xla_us": 493.0},
+        "decode_attn": {"pallas_us": 539.9, "xla_us": 267.7,
+                        "recorded": "BENCH_r05"},
+        "decode_attn_fp8": {"pallas_us": 561.1, "xla_us": 493.2,
+                            "recorded": "BENCH_r05"},
+        "paged_decode_attn": {"pallas_us": 540.0, "xla_us": 268.0,
+                              "recorded": "BENCH_r05"},
+        "paged_decode_attn_fp8": {"pallas_us": 561.0, "xla_us": 493.0,
+                                  "recorded": "BENCH_r05"},
+        "ragged_attn": {"pallas_us": 540.0, "xla_us": 268.0,
+                        "recorded": "BENCH_r06"},
+        "ragged_attn_fp8": {"pallas_us": 561.0, "xla_us": 493.0,
+                            "recorded": "BENCH_r06"},
         # fused dequant-matmul, decode shape (M=1, the serving weight
         # read): BENCH_r12 interpret rows — the XLA block-dequant path
         # wins at every M in 1..8 (M=1: 64.1 vs 15.1us; M=8: 40.2 vs
         # 30.3us), so an int4-weight serving engine on CPU provably
         # selects XLA instead of inheriting a blanket platform rule
-        "qmatmul_sym_int4": {"pallas_us": 64.1, "xla_us": 15.1},
+        "qmatmul_sym_int4": {"pallas_us": 64.1, "xla_us": 15.1,
+                             "recorded": "BENCH_r12"},
     },
     "tpu": {},  # no recorded loss: platform default (pallas) stands
 }
@@ -167,6 +178,25 @@ def _op_family(row_op: str) -> str:
     return fam
 
 
+def _override_stamp(path: str, row: dict | None = None) -> str:
+    """Recorded-at provenance for an override-ladder row: the row's own
+    bench-round stamp when the dump carries one, else the dump file's
+    mtime date — an override is a measurement too, and /health must show
+    WHEN it was taken, not just that it exists."""
+    if row:
+        for key in ("recorded", "round", "bench_round"):
+            if row.get(key):
+                return str(row[key])
+    try:
+        import datetime
+
+        mtime = os.path.getmtime(path)
+        day = datetime.datetime.fromtimestamp(mtime).date().isoformat()
+        return f"override:{os.path.basename(path)}@{day}"
+    except OSError:
+        return f"override:{os.path.basename(path)}"
+
+
 @lru_cache(maxsize=1)
 def _ladder() -> dict[str, dict[str, dict[str, float]]]:
     path = os.environ.get("IPEX_LLM_TPU_DISPATCH_LADDER", "")
@@ -180,7 +210,8 @@ def _ladder() -> dict[str, dict[str, dict[str, float]]]:
             if "pallas_us" in row and "xla_us" in row:
                 table[_op_family(row.get("op", ""))] = {
                     "pallas_us": float(row["pallas_us"]),
-                    "xla_us": float(row["xla_us"])}
+                    "xla_us": float(row["xla_us"]),
+                    "recorded": _override_stamp(path, row)}
         # collect() marks interpret-mode rows, so the dump itself records
         # which backend family it measured: interpret rows = CPU, plain
         # rows = compiled TPU.  Keying on the dump, NOT the loading
@@ -191,6 +222,12 @@ def _ladder() -> dict[str, dict[str, dict[str, float]]]:
         backend = ("cpu" if any(r.get("interpret") for r in data)
                    else "tpu")
         return {backend: table}
+    # table form: stamp any row missing provenance with the file's
+    for fams in data.values():
+        if isinstance(fams, dict):
+            for rec in fams.values():
+                if isinstance(rec, dict) and "recorded" not in rec:
+                    rec["recorded"] = _override_stamp(path)
     return data
 
 
@@ -253,6 +290,41 @@ def _use_pallas_env(op: str | None = None) -> bool:
 def use_pallas_sharded(op: str | None = None) -> bool:
     """Kernel eligibility for shard_map-wrapped entry points."""
     return _use_pallas_env(op)
+
+
+def ladder_provenance() -> dict:
+    """The /health ``dispatch`` block: where every Pallas-vs-XLA auto
+    decision on THIS platform comes from and when it was measured.
+
+    Per op family: the recorded pair, the winner the pair selects, and
+    the ``recorded`` bench-round stamp (builtin rows carry the round that
+    measured them — BENCH_r05/r06/r12 as of this writing; an
+    ``IPEX_LLM_TPU_DISPATCH_LADDER`` override is stamped from the dump's
+    own round field or its file mtime).  ``recorded: "unstamped"`` means
+    a hand-edited table with no provenance at all — the loudest kind of
+    stale."""
+    platform = backend_platform()
+    table = _ladder().get(platform, {})
+    fams = {}
+    for fam, rec in sorted(table.items()):
+        try:
+            prefers = ("pallas" if float(rec["pallas_us"])
+                       <= float(rec["xla_us"]) else "xla")
+        except (KeyError, TypeError, ValueError):
+            prefers = None
+        fams[fam] = {
+            "pallas_us": rec.get("pallas_us"),
+            "xla_us": rec.get("xla_us"),
+            "prefers": prefers,
+            "recorded": rec.get("recorded", "unstamped"),
+        }
+    return {
+        "platform": platform,
+        "source": (os.environ.get("IPEX_LLM_TPU_DISPATCH_LADDER")
+                   or "builtin"),
+        "default": "pallas" if platform == "tpu" else "xla",
+        "families": fams,
+    }
 
 
 def clear_cache() -> None:

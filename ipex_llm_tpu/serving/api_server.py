@@ -525,6 +525,21 @@ class OpenAIServer:
                 "collective_qtype": eng._collective_qtype,
                 "kv_pool_bytes_per_shard": int(shard_bytes),
             }
+        # device-time observatory (serving/perfwatch.py): per-family
+        # attribution buckets + MFU/roofline join, and the recompile
+        # sentinel — compiles_warm or compiles_out_of_grid advancing
+        # mid-serving is the first thing to read when tick latency
+        # develops multi-second spikes (a shape-driven recompile is
+        # invisible in every other series)
+        perf = self.engine.perf_view()
+        if perf is not None:
+            body["perf"] = perf
+        # dispatch-ladder provenance: which measured microbench round
+        # each Pallas-vs-XLA decision rests on — a stale ladder (builtin
+        # rows date to BENCH_r05/r12) is visible instead of silently
+        # trusted
+        from ipex_llm_tpu.ops.dispatch import ladder_provenance
+        body["dispatch"] = ladder_provenance()
         # fault-domain observability: admission backlog vs the bound (what
         # a 429 means), per-request failures isolated by bisection,
         # transient step retries, load-shed and deadline-expired counts
@@ -558,6 +573,12 @@ class OpenAIServer:
         for k, v in self.engine.weight_stats().items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"weights_{k}"] = v
+        # perfwatch counters (perf_ prefix): the recompile-sentinel
+        # series (compiles_total/warm/out_of_grid are fleet-summable
+        # true counters) + per-family attributed ticks/device seconds
+        for k, v in self.engine.perf_numeric().items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = v
         out["uptime_s"] = round(
             time.monotonic() - self.started_monotonic, 3)
         return out

@@ -32,6 +32,7 @@ import threading
 import time
 import uuid
 from collections import OrderedDict, deque
+from contextlib import nullcontext
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any
@@ -206,6 +207,19 @@ class EngineConfig:
     trace_requests: bool = False
     trace_buffer: int = 256     # traces retained (LRU); spans/trace capped
     flight_ring: int = 256      # tick records the flight recorder retains
+    # device-time observatory (serving/perfwatch.py): per-tick wall-clock
+    # attribution (dispatch / device-execute / host-sync / host-
+    # bookkeeping buckets per program family, rollback-covered
+    # histograms on /metrics + per-tick flight-recorder fields), the
+    # runtime recompile sentinel (JP104's twin: jax.monitoring compile
+    # events classified against the manifest-locked grid in
+    # analysis/programs.lock.json — warm-path and out-of-grid compiles
+    # flagged in /health's perf block), and MFU/roofline accounting
+    # joining measured device time against the manifest's cost_analysis
+    # for the dispatched grid point.  All host clock reads at points the
+    # tick already visits — no new device programs or syncs, JP106 stays
+    # ==1.  False = no PerfWatch at all (bench_observe prices the pair).
+    perfwatch: bool = True
     # multi-chip collective wire family (ops/collectives.py, the EQuARX
     # axis): what the manual-mesh tick's row-parallel AllReduces carry.
     # "bf16" = the exact family (f32 accumulation; tp2 output is
@@ -1390,6 +1404,44 @@ class ServingEngine:
             "tick_sync_s": Histogram(FAST_LATENCY_BUCKETS_S),
             "swap_in_s": Histogram(FAST_LATENCY_BUCKETS_S),
         }
+        # device-time observatory (serving/perfwatch.py): attribution
+        # histograms register into self.hists so checkpoint/rollback and
+        # the committed /metrics exposition cover them for free; the
+        # manifest + audit-model flops give the MFU join its cost basis
+        # (a stripped install without the analysis package keeps serving
+        # — the sentinel then counts compiles without grid membership
+        # and the MFU join reports None).
+        self.perf = None
+        self._perf_asserted = False
+        if self.ec.perfwatch:
+            from ipex_llm_tpu.serving.perfwatch import (PerfWatch,
+                                                        model_flops_per_token)
+
+            manifest = None
+            scales: dict[str, float] = {}
+            try:
+                from ipex_llm_tpu.analysis.trace import manifest as _mf
+                from ipex_llm_tpu.analysis.trace.registry import (
+                    audit_cfg, audit_cfg_tp)
+
+                loaded = _mf.load()
+                mine = model_flops_per_token(cfg)
+                scales = {
+                    "bf16": mine / model_flops_per_token(audit_cfg("bf16")),
+                    "sym_int4": mine / model_flops_per_token(
+                        audit_cfg("sym_int4")),
+                    "tp": mine / model_flops_per_token(audit_cfg_tp()),
+                }
+                # assigned only once the scales computed: a manifest
+                # without its model scale would join MFU at scale 1.0 —
+                # the audit model's flops reported as this model's,
+                # silently wrong by orders of magnitude (None is the
+                # honest degraded mode)
+                manifest = loaded
+            except Exception:
+                scales = {}
+            self.perf = PerfWatch(hists=self.hists, manifest=manifest,
+                                  flops_scales=scales)
         # the COMMITTED view /metrics serves: `self.hists` mutates
         # mid-tick and reverts on rollback, so a scrape reading it live
         # could observe counts a rollback then subtracts — a Prometheus
@@ -1626,6 +1678,58 @@ class ServingEngine:
         scrape-visible, so the exposed series stay monotonic."""
         return self._hists_committed
 
+    def _perf_dispatch(self, family: str, point: dict | None = None,
+                       tick: bool = True):
+        """Perfwatch timing window around ONE device dispatch (no-op
+        context when the observatory is off).  ``tick=True`` windows
+        count toward the JP106 runtime cross-check against the
+        hand-maintained ``_tick_dispatches`` counter."""
+        if self.perf is None:
+            return nullcontext()
+        return self.perf.dispatch(family, point=point, tick=tick)
+
+    def _perf_point(self, horizon: int, width: int = 0,
+                    with_decode: bool = True, spec: bool = False,
+                    pb: int = 0, maxp: int = 0, ew: int = 0) -> dict:
+        """The dispatched tick's grid point — the SAME axes the trace
+        audit's registry grid keys ``serving.ragged_tick`` entries on
+        (rows/width/horizon/kv plus the structural spec/wq/wd/tp/cq
+        axes), which is what lets the sentinel classify a runtime
+        compile against the manifest and the MFU join find its
+        cost_analysis entry.  ``pb``/``maxp``/``ew`` are the
+        retrace-driving pad axes the audit does not lock: they ride the
+        sentinel's warm/cold identity only."""
+        pt: dict = {"rows": self.ec.max_rows, "width": int(width),
+                    "horizon": int(horizon), "kv": self.ec.kv_storage}
+        if not with_decode:
+            pt["wd"] = False
+        if spec:
+            pt["spec"] = self.ec.spec_k
+        if self._served_qtype is not None:
+            pt["wq"] = self._served_qtype
+        if self._tp_manual:
+            pt["tp"] = int(self.mesh.shape.get("tp", 1))
+            if self._collective_qtype != "bf16":
+                pt["cq"] = self._collective_qtype
+        if pb:
+            pt["pb"] = int(pb)
+        if maxp:
+            pt["maxp"] = int(maxp)
+        if ew:
+            pt["ew"] = int(ew)
+        return pt
+
+    def perf_view(self) -> dict | None:
+        """The /health ``perf`` block (None when perfwatch is off)."""
+        return self.perf.view() if self.perf is not None else None
+
+    def perf_numeric(self) -> dict:
+        """Flat ``perf_``-prefixed counters for the /metrics exposition."""
+        if self.perf is None:
+            return {}
+        return {f"perf_{k}": v
+                for k, v in self.perf.metrics_numeric().items()}
+
     def _flight_pending(self) -> dict:
         """Recovery evidence accumulated since the last RECORDED tick:
         a failed tick rolls back and never records, and _recover bumps
@@ -1664,6 +1768,8 @@ class ServingEngine:
                    or d("errors_isolated") or d("timeouts")
                    or self._tick_dispatches)
         if not working:
+            if self.perf is not None:   # discard the idle tick's scratch
+                self.perf.tick_finish(self._tick_dispatches, working=False)
             self.flight.skip_idle()
             return
         pages_before = self.ec.n_pages - 1 - len(snap["alloc"][0])
@@ -1694,11 +1800,33 @@ class ServingEngine:
                                - snap["pagestore"]["swap_ins"])
         if pend.get("fault_sites"):
             rec["fault_sites"] = pend["fault_sites"]
+        # device-time observatory: attribution buckets (summing to the
+        # tick's wall clock), the MFU join for the dispatched grid
+        # point, any compile events the sentinel attributed to this
+        # tick, and the JP106 dispatch cross-check — committed ticks
+        # only, so a rollback leaves no attribution residue
+        pf = {}
+        if self.perf is not None:
+            pf = self.perf.tick_finish(self._tick_dispatches, working=True)
+            rec.update(pf)
         # consumed: the next record's recovery deltas start here
         self._flight_retries0 = m.get("retries", 0)
         if self.injector is not None:
             self._flight_hits0 = dict(self.injector.site_hits)
         self.flight.record(rec)
+        # the runtime enforcement of JP106's hand-maintained `+= 1`
+        # bookkeeping: the observed dispatch-window count must equal
+        # _tick_dispatches.  Debug assert AFTER the ring has the
+        # evidence (under -O only the recorded field remains) — and
+        # ONCE per engine: a deterministic divergence would otherwise
+        # re-raise every tick, escalating an observability discrepancy
+        # into a permanent fail-all loop (later ticks keep recording
+        # the field and bumping perf.dispatch_mismatches).
+        if pf.get("dispatch_mismatch") and not self._perf_asserted:
+            self._perf_asserted = True
+            assert False, (
+                "JP106 runtime cross-check diverged: "
+                f"{pf['dispatch_mismatch']} (see the flight ring)")
 
     @property
     def draining(self) -> bool:
@@ -1863,8 +1991,14 @@ class ServingEngine:
         m["rejected"] = max(self.metrics.get("rejected", 0),
                             m.get("rejected", 0))
         self.metrics = m
-        for k, h in self.hists.items():
-            h.restore(snap["hists"][k])
+        for k in list(self.hists):
+            if k in snap["hists"]:
+                self.hists[k].restore(snap["hists"][k])
+            else:
+                # a perfwatch family histogram born inside the rolled-
+                # back tick (lazy registration): it never existed at the
+                # checkpoint, so it does not exist now
+                del self.hists[k]
         # staged spans discard with the tick: clients saw no tokens, the
         # trace must show no spans (the retry/quarantine events recovery
         # writes are post-rollback, so they survive by construction)
@@ -1902,8 +2036,14 @@ class ServingEngine:
                 self.tracer.add(tid, s)
         self.metrics["queue_depth"] = self.queue_depth
         # republish the scrape-visible histogram view (O(buckets), same
-        # cost class as the per-tick checkpoint snapshots)
-        self._hists_committed = {k: h.copy() for k, h in self.hists.items()}
+        # cost class as the per-tick checkpoint snapshots).  With the
+        # observatory on, the republish happens at the end of _tick
+        # instead (attribution observes in _flight_record, after this
+        # point) — doing it here too would copy every histogram twice
+        # per tick for nothing.
+        if self.perf is None:
+            self._hists_committed = {k: h.copy()
+                                     for k, h in self.hists.items()}
 
     def _tick(self):
         """ONE transactional engine tick: checkpoint, run the step,
@@ -1919,10 +2059,14 @@ class ServingEngine:
         self._span_staging = []
         self._tick_arrivals = []
         self._tick_dispatches = 0
+        if self.perf is not None:
+            self.perf.tick_begin()
         t_wall = time.time()
         try:
             self._step_once()
         except Exception as exc:
+            if self.perf is not None:
+                self.perf.tick_abort()   # a rolled-back tick measures nothing
             self._rollback(snap)
             self._recover(exc)
             return False
@@ -1932,6 +2076,12 @@ class ServingEngine:
         # liveness counter, so `ticks` moves iff the engine makes progress
         self.metrics["ticks"] = self.metrics.get("ticks", 0) + 1
         self._flight_record(snap["metrics"], snap, t_wall)
+        if self.perf is not None:
+            # the attribution observations land in _flight_record (post-
+            # commit, committed ticks only) — republish so the scrape
+            # view includes THIS tick's buckets, not last tick's
+            self._hists_committed = {k: h.copy()
+                                     for k, h in self.hists.items()}
         return True
 
     def _recover(self, exc: BaseException):
@@ -2036,7 +2186,9 @@ class ServingEngine:
                          # never recorded — their retries/injector hits
                          # ride the dump itself
                          **{f"{k}_pending": v for k, v
-                            in self._flight_pending().items() if v})
+                            in self._flight_pending().items() if v},
+                         **(self.perf.dump_fields()
+                            if self.perf is not None else {}))
         self._trace(req, "quarantine",
                     error=f"{type(exc).__name__}: {exc}")
         for i, r in enumerate(self.rows):
@@ -2192,19 +2344,27 @@ class ServingEngine:
             return {}
         t0 = time.perf_counter()
         t0_w = time.time()
-        k_stack = np.stack([e[0] for _, e in taken], axis=1)
-        v_stack = np.stack([e[1] for _, e in taken], axis=1)
-        self.cache = self.cache.scatter_pages(
-            np.asarray(pids, np.int32), h2d(k_stack), h2d(v_stack))
-        # completion barrier: swap-in latency must cover the scatter
-        # REACHING the pool, not just its enqueue — on an async backend
-        # the enqueue-only figure was vacuous (microseconds regardless of
-        # page size), and the admission that depends on these pages blocks
-        # on exactly this work anyway.  Epoch-boundary sync, not tick
-        # work (JP106 untouched).
-        # jaxlint: disable=JL002 -- designed epoch-boundary completion barrier: the swap-in p95 /health reports must measure transfer completion, not dispatch enqueue (the PR 11 vacuous-timing fix)
-        self.cache.k.block_until_ready()
-        self.cache.v.block_until_ready()  # jaxlint: disable=JL002 -- rides the same designed swap-in barrier; k already blocked above
+        epoch = (self.perf.epoch_window("swap_in")
+                 if self.perf is not None else nullcontext())
+        with epoch:
+            k_stack = np.stack([e[0] for _, e in taken], axis=1)
+            v_stack = np.stack([e[1] for _, e in taken], axis=1)
+            with self._perf_dispatch("swap_in", tick=False):
+                self.cache = self.cache.scatter_pages(
+                    np.asarray(pids, np.int32), h2d(k_stack),
+                    h2d(v_stack))
+            t_bar = time.perf_counter()
+            # completion barrier: swap-in latency must cover the scatter
+            # REACHING the pool, not just its enqueue — on an async
+            # backend the enqueue-only figure was vacuous (microseconds
+            # regardless of page size), and the admission that depends on
+            # these pages blocks on exactly this work anyway.  Epoch-
+            # boundary sync, not tick work (JP106 untouched).
+            # jaxlint: disable=JL002 -- designed epoch-boundary completion barrier: the swap-in p95 /health reports must measure transfer completion, not dispatch enqueue (the PR 11 vacuous-timing fix)
+            self.cache.k.block_until_ready()
+            self.cache.v.block_until_ready()  # jaxlint: disable=JL002 -- rides the same designed swap-in barrier; k already blocked above
+            if self.perf is not None:
+                self.perf.note_sync(time.perf_counter() - t_bar)
         seconds = time.perf_counter() - t0
         self.pagestore.record_swap_in(seconds, pages=len(taken))
         self.hists["swap_in_s"].observe(seconds)
@@ -2286,6 +2446,12 @@ class ServingEngine:
         return self.run_on_engine(lambda: self._export_prefix_op(ids, wire))
 
     def _export_prefix_op(self, ids: np.ndarray, wire: str):
+        epoch = (self.perf.epoch_window("handoff")
+                 if self.perf is not None else nullcontext())
+        with epoch:
+            return self._export_prefix_inner(ids, wire)
+
+    def _export_prefix_inner(self, ids: np.ndarray, wire: str):
         from ipex_llm_tpu.serving import kv_transport
 
         if wire == "auto":
@@ -2346,6 +2512,12 @@ class ServingEngine:
         return self.run_on_engine(lambda: self._import_pages_op(blob))
 
     def _import_pages_op(self, blob: bytes) -> dict:
+        epoch = (self.perf.epoch_window("handoff")
+                 if self.perf is not None else nullcontext())
+        with epoch:
+            return self._import_pages_inner(blob)
+
+    def _import_pages_inner(self, blob: bytes) -> dict:
         from ipex_llm_tpu.serving import kv_transport
 
         meta, pages = kv_transport.unpack_pages(blob)
@@ -2661,12 +2833,13 @@ class ServingEngine:
         # the last device call are scattered in (this row's new pages),
         # not the whole [R, maxP] table per chunk
         cache = self._flush_dirty_tables()
-        logits, self.cache = _prefill_chunk(
-            self.cfg, self.params, cache, h2d(toks),
-            h2d(self.tables[row : row + 1]),
-            h2d(base, jnp.int32), h2d(n_valid, jnp.int32),
-            mesh=self.mesh,
-        )
+        with self._perf_dispatch("tick.seq_prefill"):
+            logits, self.cache = _prefill_chunk(
+                self.cfg, self.params, cache, h2d(toks),
+                h2d(self.tables[row : row + 1]),
+                h2d(base, jnp.int32), h2d(n_valid, jnp.int32),
+                mesh=self.mesh,
+            )
         self._tick_dispatches += 1
         self.row_lens[row] = base + n_valid
         self._trace(req, "prefill_chunk", t0=t0_w, t1=time.time(),
@@ -2785,7 +2958,9 @@ class ServingEngine:
         self.flight.dump("fail_all",
                          error=f"{type(exc).__name__}: {exc}",
                          **{f"{k}_pending": v for k, v
-                            in self._flight_pending().items() if v})
+                            in self._flight_pending().items() if v},
+                         **(self.perf.dump_fields()
+                            if self.perf is not None else {}))
         for i, req in enumerate(self.rows):
             if req is not None:
                 self._finish(i, "error")
@@ -2922,14 +3097,15 @@ class ServingEngine:
         if self._pp_mode:
             verify_fn = _pp_verify_step
             extra = {"n_micro": self.mesh.shape["pp"]}
-        t_all, lp_all, self.cache, self.key = verify_fn(
-            self.cfg, self.params, cache,
-            h2d(self.toks), h2d(drafts),
-            h2d(self.row_lens), h2d(active),
-            h2d(self.temps), h2d(self.top_ps), self.key,
-            h2d(self.seeds), h2d(steps),
-            h2d(self.top_ks), k=k, mesh=self.mesh, **extra,
-        )
+        with self._perf_dispatch("tick.spec_host"):
+            t_all, lp_all, self.cache, self.key = verify_fn(
+                self.cfg, self.params, cache,
+                h2d(self.toks), h2d(drafts),
+                h2d(self.row_lens), h2d(active),
+                h2d(self.temps), h2d(self.top_ps), self.key,
+                h2d(self.seeds), h2d(steps),
+                h2d(self.top_ks), k=k, mesh=self.mesh, **extra,
+            )
         self._tick_dispatches += 1
         t0 = time.perf_counter()
         # jaxlint: disable=JL002 -- designed sync: the verify round's accepted tokens must reach the host to walk acceptance chains; counted via _count_sync
@@ -3244,33 +3420,38 @@ class ServingEngine:
         # whole at epoch uploads, and nothing is emitted)
         tick_spec = self._fused_spec and with_decode
         take_block = s_prop = s_acc = None
+        perf_pt = self._perf_point(
+            1, width=width, with_decode=with_decode, spec=tick_spec,
+            pb=p_b, maxp=maxp_b, ew=int(dev["eos"].shape[1]))
         if tick_spec:
-            (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
-             dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
-             dev["remain"], self.key, take_block, dev["hist"], s_prop,
-             s_acc) = _ragged_tick_fn(
-                self.cfg, self.params, self.cache, dev["toks"],
-                dev["row_lens"], dev["active"], dev["temps"],
-                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
-                dev["top_ks"], dev["eos"], dev["remain"],
-                prefill=prefill, horizon=1, with_decode=True,
-                hist=dev["hist"], spec_ks=h2d(spec_ks),
-                spec_k=self.ec.spec_k, spec_ngram=self.ec.spec_ngram,
-                mesh=self.mesh, tp_manual=self._tp_manual,
-                collective_qtype=self._collective_qtype)
+            with self._perf_dispatch("tick.spec", point=perf_pt):
+                (first_t, first_lp, tok_block, lp_block, n_exec,
+                 self.cache, dev["toks"], dev["row_lens"], dev["active"],
+                 dev["steps"], dev["remain"], self.key, take_block,
+                 dev["hist"], s_prop, s_acc) = _ragged_tick_fn(
+                    self.cfg, self.params, self.cache, dev["toks"],
+                    dev["row_lens"], dev["active"], dev["temps"],
+                    dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                    dev["top_ks"], dev["eos"], dev["remain"],
+                    prefill=prefill, horizon=1, with_decode=True,
+                    hist=dev["hist"], spec_ks=h2d(spec_ks),
+                    spec_k=self.ec.spec_k, spec_ngram=self.ec.spec_ngram,
+                    mesh=self.mesh, tp_manual=self._tp_manual,
+                    collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
         else:
-            (first_t, first_lp, tok_block, lp_block, n_exec, self.cache,
-             dev["toks"], dev["row_lens"], dev["active"], dev["steps"],
-             dev["remain"], self.key) = _ragged_tick_fn(
-                self.cfg, self.params, self.cache, dev["toks"],
-                dev["row_lens"], dev["active"], dev["temps"],
-                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
-                dev["top_ks"], dev["eos"], dev["remain"],
-                prefill=prefill, horizon=1,
-                with_decode=with_decode, mesh=self.mesh,
-                tp_manual=self._tp_manual,
-                collective_qtype=self._collective_qtype)
+            with self._perf_dispatch("tick.admission", point=perf_pt):
+                (first_t, first_lp, tok_block, lp_block, n_exec,
+                 self.cache, dev["toks"], dev["row_lens"], dev["active"],
+                 dev["steps"], dev["remain"], self.key) = _ragged_tick_fn(
+                    self.cfg, self.params, self.cache, dev["toks"],
+                    dev["row_lens"], dev["active"], dev["temps"],
+                    dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                    dev["top_ks"], dev["eos"], dev["remain"],
+                    prefill=prefill, horizon=1,
+                    with_decode=with_decode, mesh=self.mesh,
+                    tp_manual=self._tp_manual,
+                    collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
         # advance bookkeeping; completed prompts run the shared
         # completion path (_finish_prompt) once their token arrives
@@ -3399,12 +3580,16 @@ class ServingEngine:
                                 if active[i]])
         t0_w = time.time()
         dev = self._sync_device_state()
+        perf_pt = self._perf_point(h, width=0, spec=self._fused_spec,
+                                   ew=int(dev["eos"].shape[1]))
         if self._pp_mode:
-            nxt, lp, self.cache, self.key = _pp_decode_sample(
-                self.cfg, self.params, self.cache, dev["toks"],
-                dev["row_lens"], dev["active"], dev["temps"], dev["top_ps"],
-                self.key, dev["seeds"], dev["steps"], dev["top_ks"],
-                mesh=self.mesh, n_micro=self.mesh.shape["pp"])  # jaxlint: disable=JL003 -- pp mesh shape is fixed for the engine lifetime: exactly one compiled program
+            with self._perf_dispatch("tick.pp"):
+                nxt, lp, self.cache, self.key = _pp_decode_sample(
+                    self.cfg, self.params, self.cache, dev["toks"],
+                    dev["row_lens"], dev["active"], dev["temps"],
+                    dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                    dev["top_ks"],
+                    mesh=self.mesh, n_micro=self.mesh.shape["pp"])  # jaxlint: disable=JL003 -- pp mesh shape is fixed for the engine lifetime: exactly one compiled program
             self._tick_dispatches += 1
             tok_block, lp_block = nxt[:, None], lp[:, None]
             # the pp schedule stays H=1 for now (a horizon scan would nest
@@ -3416,19 +3601,20 @@ class ServingEngine:
             # the spec-enabled form of the SAME single entry: drafting,
             # the [R, k+1] verify, and acceptance all ride inside the
             # horizon loop — still one dispatch (JP106 unchanged)
-            (_, _, tok_block, lp_block, n_exec, self.cache, dev["toks"],
-             dev["row_lens"], dev["active"], dev["steps"], dev["remain"],
-             self.key, take_block, dev["hist"], s_prop,
-             s_acc) = _ragged_tick_fn(
-                self.cfg, self.params, self.cache, dev["toks"],
-                dev["row_lens"], dev["active"], dev["temps"],
-                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
-                dev["top_ks"], dev["eos"], dev["remain"],
-                prefill=None, horizon=h, hist=dev["hist"],
-                spec_ks=h2d(spec_ks), spec_k=self.ec.spec_k,
-                spec_ngram=self.ec.spec_ngram, mesh=self.mesh,
-                tp_manual=self._tp_manual,
-                collective_qtype=self._collective_qtype)
+            with self._perf_dispatch("tick.spec", point=perf_pt):
+                (_, _, tok_block, lp_block, n_exec, self.cache,
+                 dev["toks"], dev["row_lens"], dev["active"],
+                 dev["steps"], dev["remain"], self.key, take_block,
+                 dev["hist"], s_prop, s_acc) = _ragged_tick_fn(
+                    self.cfg, self.params, self.cache, dev["toks"],
+                    dev["row_lens"], dev["active"], dev["temps"],
+                    dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                    dev["top_ks"], dev["eos"], dev["remain"],
+                    prefill=None, horizon=h, hist=dev["hist"],
+                    spec_ks=h2d(spec_ks), spec_k=self.ec.spec_k,
+                    spec_ngram=self.ec.spec_ngram, mesh=self.mesh,
+                    tp_manual=self._tp_manual,
+                    collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
         else:
             # the steady-state tick is the SAME single jitted entry the
@@ -3437,16 +3623,17 @@ class ServingEngine:
             # to exactly 1 (the decode stage traces _decode_horizon_loop,
             # so output is bit-identical to the historical
             # _decode_multi_step program)
-            (_, _, tok_block, lp_block, n_exec, self.cache, dev["toks"],
-             dev["row_lens"], dev["active"], dev["steps"], dev["remain"],
-             self.key) = _ragged_tick_fn(
-                self.cfg, self.params, self.cache, dev["toks"],
-                dev["row_lens"], dev["active"], dev["temps"],
-                dev["top_ps"], self.key, dev["seeds"], dev["steps"],
-                dev["top_ks"], dev["eos"], dev["remain"],
-                prefill=None, horizon=h, mesh=self.mesh,
-                tp_manual=self._tp_manual,
-                collective_qtype=self._collective_qtype)
+            with self._perf_dispatch("tick.steady", point=perf_pt):
+                (_, _, tok_block, lp_block, n_exec, self.cache,
+                 dev["toks"], dev["row_lens"], dev["active"],
+                 dev["steps"], dev["remain"], self.key) = _ragged_tick_fn(
+                    self.cfg, self.params, self.cache, dev["toks"],
+                    dev["row_lens"], dev["active"], dev["temps"],
+                    dev["top_ps"], self.key, dev["seeds"], dev["steps"],
+                    dev["top_ks"], dev["eos"], dev["remain"],
+                    prefill=None, horizon=h, mesh=self.mesh,
+                    tp_manual=self._tp_manual,
+                    collective_qtype=self._collective_qtype)
             self._tick_dispatches += 1
             # the returned cache owns the (donated) tables buffer now
         t0 = time.perf_counter()
@@ -3456,6 +3643,10 @@ class ServingEngine:
             # jaxlint: disable=JL002 -- rides THE per-horizon sync: < h only if every row died early
             executed = int(d2h(n_exec))
         self._count_sync(time.perf_counter() - t0)
+        if self.perf is not None:
+            # the MFU join's loop multiplier: XLA's cost analysis counts
+            # the horizon body once, the tick executed it `executed` times
+            self.perf.note_executed(executed)
         self.metrics["steps"] += executed
         self.metrics["decode_horizon_effective"] = h
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
@@ -3527,6 +3718,8 @@ class ServingEngine:
         fused horizon amortizes over H tokens)."""
         self.metrics["host_syncs"] += 1
         self.hists["tick_sync_s"].observe(seconds)
+        if self.perf is not None:
+            self.perf.note_sync(seconds)
         self.metrics["host_sync_s"] = round(
             self.metrics["host_sync_s"] + seconds, 6)
 

@@ -75,6 +75,11 @@ class FastChatWorker:
             # FastChat fleet is traceable/postmortem-able too
             web.get("/trace/{trace_id}", self.api_trace),
             web.get("/debug/flight", self.api_flight),
+            # device-time observatory (serving/perfwatch.py): the perf
+            # block api_server serves under /health, plus the dispatch-
+            # ladder provenance — a FastChat worker's recompile sentinel
+            # and MFU join are inspectable without the OpenAI surface
+            web.get("/debug/perf", self.api_perf),
         ])
         # graceful drain on SIGTERM (reference workers restart-on-error;
         # here the replica finishes in-flight requests before exiting)
@@ -275,6 +280,12 @@ class FastChatWorker:
 
     async def api_flight(self, request: web.Request):
         return web.json_response(self.engine.flight.view())
+
+    async def api_perf(self, request: web.Request):
+        from ipex_llm_tpu.ops.dispatch import ladder_provenance
+
+        return web.json_response({"perf": self.engine.perf_view(),
+                                  "dispatch": ladder_provenance()})
 
 
 def build_worker(model_path: str, low_bit: str = "sym_int4",

@@ -790,6 +790,15 @@ _FLEET_SUMMABLE = frozenset({
     # exposition; pages_imported/exported live in engine.metrics)
     "kv_spill_pages", "kv_spill_bytes", "kv_spills", "kv_swap_ins",
     "kv_swap_in_lookups", "kv_pages_imported", "kv_pages_exported",
+    # device-time observatory (serving/perfwatch.py): the recompile-
+    # sentinel series are true counters — a fleet sum of
+    # perf_compiles_warm/out_of_grid > 0 is the one-glance "somebody is
+    # recompiling mid-serving" signal; attributed ticks/compile seconds
+    # sum the same way (MFU and per-family device_s are ratios/gauges,
+    # per-replica only)
+    "perf_compiles_total", "perf_compiles_warm",
+    "perf_compiles_out_of_grid", "perf_compile_s_total",
+    "perf_ticks_attributed", "perf_dispatch_mismatches",
 })
 
 

@@ -1,0 +1,588 @@
+"""Device-time observatory (PR 15, serving/perfwatch.py).
+
+The contracts under test:
+
+- **attribution bucket math**: the four buckets (dispatch / device /
+  sync / bookkeep) PARTITION the tick wall clock exactly — unit-level
+  over synthetic windows, and engine-level over every committed
+  flight-ring record (the acceptance bound: sum within 5% of wall);
+- **recompile sentinel**: quiet across the manifest-locked grid (an
+  on-grid engine's compiles are all cold, zero warm, zero out-of-grid),
+  fires on a deliberately out-of-grid shape (counted, flagged in the
+  perf view, recorded in the flight ring), and the membership rules
+  (pow2-within-max magnitude axes, exact structural axes) are pinned;
+- **MFU join**: hand-computed against a synthetic manifest entry —
+  scale x executed multiplier, linear rows fallback — and nonzero
+  end-to-end on the real tiny model via the real programs.lock.json;
+- **rollback residue**: a transient-faulted tick that rolls back
+  contributes NOTHING — histogram observation counts equal the
+  committed per-family tick counts exactly;
+- **JP106 runtime cross-check**: a dispatch the hand-maintained counter
+  sees but perfwatch does not (or vice versa) records a
+  ``dispatch_mismatch`` flight field and raises the debug assert;
+- **surfaces**: /health carries the ``perf`` block and the
+  ``dispatch`` ladder-provenance block (recorded-at bench-round
+  stamps), /metrics carries the ``perf_*`` counters and per-family
+  attribution histograms, and the router fleet-SUMS the sentinel
+  counters.
+"""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ipex_llm_tpu.serving.engine import (EngineConfig, Request,
+                                         ServingEngine, stream_tokens)
+from ipex_llm_tpu.serving.faults import FaultInjector, TransientFault
+from ipex_llm_tpu.serving.perfwatch import (PerfWatch, locked_points,
+                                            model_flops_per_token,
+                                            parse_point_key, point_in_grid)
+from tests.test_decoder import rand_params, tiny_cfg
+
+RNG = np.random.default_rng(29)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = tiny_cfg(vocab_size=131, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    return cfg, rand_params(cfg, qtype="bf16")
+
+
+def _drive(eng, reqs, ticks=4000):
+    if isinstance(reqs, Request):
+        reqs = [reqs]
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(ticks):
+        eng._tick()
+        if all(r.finish_reason is not None for r in reqs):
+            return [list(stream_tokens(r, timeout=5)) for r in reqs]
+    raise AssertionError("requests never finished")
+
+
+def _prompts(n, length, vocab=131):
+    return [list(RNG.integers(1, vocab, length).astype(int))
+            for _ in range(n)]
+
+
+# -- bucket math (unit) ------------------------------------------------------
+
+def test_bucket_classification_partitions_wall():
+    w = PerfWatch(hists={})
+    w.tick_begin()
+    time.sleep(0.004)                      # pre-dispatch bookkeeping
+    with w.dispatch("tick.steady"):
+        time.sleep(0.010)                  # "the jitted call"
+    time.sleep(0.006)                      # overlapped window
+    w.note_sync(0.004)                     # blocked the last 4ms of it
+    time.sleep(0.003)                      # post-sync drain walk
+    out = w.tick_finish(manual_dispatches=1, working=True)
+    a = out["attrib"]
+    # the partition is exact by construction (4 fields rounded to 1e-6)
+    assert abs(sum(a.values()) - out["wall_s"]) < 5e-6
+    assert a["dispatch"] >= 0.009
+    assert 0.003 <= a["sync"] <= 0.006
+    # the gap between dispatch return and sync start is device time
+    assert a["device"] >= 0.001
+    # pre-dispatch + post-sync host work
+    assert a["bookkeep"] >= 0.005
+    assert out["perf_family"] == "tick.steady"
+    assert "dispatch_mismatch" not in out
+    # histograms registered per (family, bucket) and observed once
+    for b in ("dispatch", "device", "sync", "bookkeep"):
+        assert w.hists[f"perf_tick_steady_{b}_s"].count == 1
+
+
+def test_idle_tick_discards_scratch():
+    w = PerfWatch(hists={})
+    w.tick_begin()
+    assert w.tick_finish(manual_dispatches=0, working=False) == {}
+    assert w.ticks_attributed == 0
+    assert w.hists == {}
+
+
+def test_dispatch_crosscheck_unit():
+    w = PerfWatch(hists={})
+    w.tick_begin()
+    with w.dispatch("tick.steady"):
+        pass
+    out = w.tick_finish(manual_dispatches=2, working=True)
+    assert out["dispatch_mismatch"] == {"observed": 1, "manual": 2}
+    assert w.dispatch_mismatches == 1
+
+
+# -- grid membership (unit) --------------------------------------------------
+
+def test_point_in_grid_rules():
+    locked = [parse_point_key(k) for k in (
+        "horizon=1,kv=bf16,rows=4,width=0",
+        "horizon=8,kv=bf16,rows=8,width=0",
+        "horizon=1,kv=bf16,rows=4,width=8",
+        "horizon=1,kv=bf16,rows=4,width=128",
+        "horizon=1,kv=bf16,rows=4,wd=False,width=8",
+        "horizon=1,kv=bf16,rows=4,spec=4,width=0",
+    )]
+    ok = lambda **pt: point_in_grid(pt, locked)   # noqa: E731
+    # exact and pow2-within-max magnitudes
+    assert ok(rows=4, width=0, horizon=1, kv="bf16")
+    assert ok(rows=8, width=0, horizon=4, kv="bf16")      # pow2 <= max
+    assert ok(rows=2, width=16, horizon=1, kv="bf16")     # sampled around
+    # the engine-pad axes (pb/maxp/ew) never affect membership
+    assert ok(rows=4, width=8, horizon=1, kv="bf16", pb=4, maxp=2, ew=2)
+    # magnitude violations
+    assert not ok(rows=6, width=0, horizon=1, kv="bf16")  # not pow2
+    assert not ok(rows=16, width=0, horizon=1, kv="bf16")  # > max
+    assert not ok(rows=4, width=256, horizon=1, kv="bf16")  # > max
+    assert not ok(rows=4, width=0, horizon=16, kv="bf16")   # > max
+    # structural violations
+    assert not ok(rows=4, width=0, horizon=1, kv="fp8")
+    assert not ok(rows=4, width=0, horizon=1, kv="bf16", wq="sym_int4")
+    assert not ok(rows=4, width=0, horizon=1, kv="bf16", tp=2)
+    # wd=False only matches the wd=False family (and it is width>0 only)
+    assert ok(rows=4, width=8, horizon=1, kv="bf16", wd=False)
+    assert not ok(rows=4, width=0, horizon=1, kv="bf16", wd=False)
+    # spec: bounded by the locked max, structural presence required
+    assert ok(rows=4, width=0, horizon=1, kv="bf16", spec=2)
+    assert not ok(rows=4, width=0, horizon=1, kv="bf16", spec=8)
+    # no manifest = membership disabled, never flags
+    assert point_in_grid({"rows": 99, "width": 3}, None)
+
+
+def test_locked_points_loads_real_manifest():
+    from ipex_llm_tpu.analysis.trace import manifest as mf
+
+    locked = locked_points(mf.load())
+    assert locked and len(locked) >= 30
+    # the steady tiny point every serving test dispatches is locked
+    assert point_in_grid(
+        {"rows": 4, "width": 0, "horizon": 1, "kv": "bf16"}, locked)
+
+
+# -- MFU join (unit, hand-computed) -----------------------------------------
+
+def _toy_manifest():
+    return {"programs": {"serving.ragged_tick": {"entries": {
+        "horizon=1,kv=bf16,rows=4,width=0":
+            {"flops": 1000, "bytes_accessed": 2000},
+        "horizon=1,kv=bf16,rows=4,width=8":
+            {"flops": 5000, "bytes_accessed": 7000},
+    }}}}
+
+
+def test_mfu_join_hand_computed_manifest_entry():
+    w = PerfWatch(hists={}, manifest=_toy_manifest(),
+                  flops_scales={"bf16": 2.0}, peak_flops=1e6,
+                  peak_bytes_s=1e6)
+    pt = {"rows": 4, "width": 0, "horizon": 1, "kv": "bf16"}
+    # exact entry: flops x scale x executed
+    assert w.cost_for(pt, executed=3) == (6000.0, 12000.0)
+    # the engine-pad axes are stripped before the cost lookup
+    assert w.cost_for({**pt, "ew": 2, "pb": 4}, executed=1) \
+        == (2000.0, 4000.0)
+    # linear-rows fallback: rows=8 has no entry, scales 2x off rows=4
+    assert w.cost_for({**pt, "rows": 8}) == (4000.0, 8000.0)
+    # linear-width fallback off the width=8 admission entry
+    f16, _ = w.cost_for({**pt, "width": 16})
+    assert f16 == pytest.approx(5000 * 2.0 * 2)
+    # nothing structurally matching: no join
+    assert w.cost_for({**pt, "kv": "fp8"}) is None
+    # end-to-end through a tick: mfu == flops / device_view / peak
+    w.tick_begin()
+    with w.dispatch("tick.steady", point=pt):
+        time.sleep(0.002)
+    w.note_sync(0.001)
+    w.note_executed(4)
+    out = w.tick_finish(manual_dispatches=1, working=True)
+    a = out["attrib"]
+    dev = a["dispatch"] + a["device"] + a["sync"]   # no compiles fired
+    assert out["mfu"] == pytest.approx(1000 * 2.0 * 4 / dev / 1e6,
+                                       rel=0.02)
+    assert out["bytes_per_s"] == pytest.approx(2000 * 2.0 * 4 / dev,
+                                               rel=0.02)
+    assert w.mfu("tick.steady") == pytest.approx(out["mfu"], rel=0.02)
+
+
+def test_model_flops_scale_basis():
+    from ipex_llm_tpu.analysis.trace.registry import audit_cfg
+
+    cfg = tiny_cfg(vocab_size=131, hidden_size=48, intermediate_size=96,
+                   num_heads=4, num_kv_heads=2, head_dim=12,
+                   max_position_embeddings=512)
+    mine, audit = (model_flops_per_token(cfg),
+                   model_flops_per_token(audit_cfg("bf16")))
+    assert mine > audit > 0
+    # hand-check the audit model's analytic flops: qkv + o + mlp + head
+    h, q, kv = 32, 4 * 8, 2 * 8
+    per_layer = h * (q + 2 * kv) + q * h + 3 * h * 64
+    assert audit == 2.0 * (2 * per_layer + h * 97)
+
+
+# -- engine-level attribution + sentinel ------------------------------------
+
+def test_engine_attribution_sums_to_tick_wall(cfg_params):
+    """The acceptance bound: every committed working tick's buckets sum
+    to within 5% of its measured wall clock, the steady family reports a
+    nonzero manifest-joined MFU, and the grid point rides the record."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=16, prefill_bucket=16,
+        decode_horizon=4))
+    outs = _drive(eng, [Request(prompt_ids=p, max_new_tokens=10)
+                        for p in _prompts(3, 24)])
+    assert all(len(o) == 10 for o in outs)
+    ring = eng.flight.view()["ring"]
+    assert ring
+    for rec in ring:
+        a = rec["attrib"]
+        assert set(a) == {"dispatch", "device", "sync", "bookkeep"}
+        assert sum(a.values()) == pytest.approx(rec["wall_s"], rel=0.05,
+                                                abs=1e-6)
+        assert rec["perf_family"].startswith("tick.")
+    steady = [r for r in ring if r["perf_family"] == "tick.steady"]
+    assert steady
+    assert any(r.get("mfu", 0) > 0 for r in steady)
+    assert all("rows=4" in r["grid_point"] for r in steady)
+    pv = eng.perf_view()
+    assert pv["families"]["tick.steady"]["mfu"] > 0
+    assert pv["families"]["tick.steady"]["flops_per_s"] > 0
+    assert pv["families"]["tick.steady"]["bytes_per_s"] > 0
+    assert pv["ticks_attributed"] == len(ring)
+    assert pv["dispatch_mismatches"] == 0
+    # the committed /metrics view carries the per-family histograms
+    hists = eng.histograms()
+    assert hists["perf_tick_steady_dispatch_s"].count == len(steady)
+    # numeric counters for the exposition
+    nm = eng.perf_numeric()
+    assert nm["perf_ticks_attributed"] == len(ring)
+    assert nm["perf_mfu"] > 0
+
+
+def test_sentinel_quiet_on_locked_grid(cfg_params):
+    """An engine whose config lands on the locked grid compiles cold
+    only: zero warm, zero out-of-grid across admission AND steady."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=64, prefill_bucket=64,
+        decode_horizon=2))
+    _drive(eng, [Request(prompt_ids=p, max_new_tokens=8)
+                 for p in _prompts(3, 20)])
+    s = eng.perf.sentinel_view()
+    assert s["compiles_total"] >= 1           # this shape is fresh here
+    assert s["compiles_warm"] == 0
+    assert s["compiles_out_of_grid"] == 0
+    assert s["grid_locked"] and s["grid_locked"] >= 30
+    assert s["compile_s_total"] > 0
+    # per-family compile attribution recorded where the compile fired
+    assert any(v["compiles"] for v in s["per_family"].values())
+
+
+def test_sentinel_fires_on_out_of_grid_shape(cfg_params):
+    """The acceptance gate's other half: a deliberately out-of-grid
+    shape (rows=6 — not a power of two, so no locked point admits it) is
+    counted, flagged in the perf view, and recorded in the flight ring."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=6, max_seq_len=256, page_size=16, prefill_bucket=16))
+    _drive(eng, Request(prompt_ids=_prompts(1, 20)[0], max_new_tokens=6))
+    s = eng.perf.sentinel_view()
+    assert s["compiles_out_of_grid"] >= 1
+    assert any("rows=6" in p for p in s["out_of_grid_points"])
+    assert s["compiles_warm"] == 0            # novel, not a re-compile
+    recs = [r for r in eng.flight.view()["ring"]
+            if r.get("compiles_out_of_grid")]
+    assert recs and recs[0]["compiles"] >= 1
+    # the postmortem dump carries the sentinel evidence too
+    d = eng.flight.dump("test")
+    d.update(eng.perf.dump_fields())
+    assert d["perf_compiles_out_of_grid"] >= 1
+
+
+def test_rollback_leaves_no_attribution_residue(cfg_params):
+    """A transient fault at the 'sample' site fires AFTER the fused
+    dispatch window opened — the tick rolls back and retries.  No bucket
+    observation, family tick count, or attributed-tick count may carry
+    the doomed tick: histogram counts == committed family ticks, and the
+    flight ring holds exactly the attributed records."""
+    cfg, params = cfg_params
+    inj = FaultInjector().inject("sample", TransientFault)
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=8,
+        decode_horizon=2, retry_backoff_s=0.001), fault_injector=inj)
+    outs = _drive(eng, [Request(prompt_ids=p, max_new_tokens=6)
+                        for p in _prompts(2, 12)])
+    assert all(len(o) == 6 for o in outs)
+    assert eng.metrics["retries"] >= 1        # the fault really fired
+    pv = eng.perf_view()
+    ring = eng.flight.view()["ring"]
+    assert pv["ticks_attributed"] == len(ring)
+    for fam, row in pv["families"].items():
+        for b in ("dispatch", "device", "sync", "bookkeep"):
+            h = eng.hists[f"perf_{fam.replace('.', '_')}_{b}_s"]
+            assert h.count == row["ticks"], (fam, b)
+    # the committed scrape view agrees with the live (post-drive) state
+    for k, h in eng.histograms().items():
+        if k.startswith("perf_"):
+            assert h.count == eng.hists[k].count
+
+
+def test_dispatch_crosscheck_fails_loudly_in_engine(cfg_params):
+    """Break the pairing deliberately (dispatch windows suppressed while
+    the hand-maintained counter still bumps): the committed tick records
+    a dispatch_mismatch field in the flight ring AND raises the debug
+    assert — the runtime enforcement of JP106's `+= 1` bookkeeping."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=32))
+    from contextlib import nullcontext
+    eng._perf_dispatch = lambda *a, **k: nullcontext()
+    eng.submit(Request(prompt_ids=_prompts(1, 8)[0], max_new_tokens=4))
+    with pytest.raises(AssertionError, match="JP106"):
+        for _ in range(50):
+            eng._tick()
+    recs = [r for r in eng.flight.view()["ring"]
+            if r.get("dispatch_mismatch")]
+    assert recs
+    mm = recs[-1]["dispatch_mismatch"]
+    assert mm["observed"] == 0 and mm["manual"] >= 1
+    assert eng.perf.dispatch_mismatches >= 1
+
+
+def test_perfwatch_disabled_engine(cfg_params):
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=32,
+        perfwatch=False))
+    _drive(eng, Request(prompt_ids=_prompts(1, 8)[0], max_new_tokens=4))
+    assert eng.perf is None
+    assert eng.perf_view() is None
+    assert eng.perf_numeric() == {}
+    assert all("attrib" not in r for r in eng.flight.view()["ring"])
+    assert not any(k.startswith("perf_") for k in eng.histograms())
+
+
+def test_handoff_epoch_family_attributed(cfg_params):
+    """Epoch-boundary work gets its own family: a prefix export (the
+    disagg handoff's first leg) lands under 'handoff' with the same
+    bucket partition, without inflating any tick family."""
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=16, prefill_bucket=32))
+    prompt = _prompts(1, 40)[0]
+    _drive(eng, Request(prompt_ids=prompt, max_new_tokens=4))
+    ticks0 = {f: r["ticks"]
+              for f, r in eng.perf_view()["families"].items()}
+    blob = eng.export_prefix(prompt)
+    assert blob
+    pv = eng.perf_view()
+    assert pv["families"]["handoff"]["ticks"] == 1
+    assert pv["families"]["handoff"]["wall_s"] > 0
+    assert eng.hists["perf_handoff_sync_s"].count == 1
+    for f, n in ticks0.items():               # tick families untouched
+        assert pv["families"][f]["ticks"] == n
+
+
+# -- ladder provenance (satellite) ------------------------------------------
+
+def test_ladder_provenance_stamps(tmp_path, monkeypatch):
+    from ipex_llm_tpu.ops import dispatch
+
+    monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+    dispatch.clear_cache()
+    try:
+        prov = dispatch.ladder_provenance()
+        assert prov["source"] == "builtin"
+        if prov["platform"] == "cpu":
+            fams = prov["families"]
+            assert fams["decode_attn"]["recorded"] == "BENCH_r05"
+            assert fams["qmatmul_sym_int4"]["recorded"] == "BENCH_r12"
+            assert fams["ragged_attn"]["recorded"] == "BENCH_r06"
+            assert fams["decode_attn"]["prefers"] == "xla"
+        # an override dump gets stamped from its own round field, or the
+        # dump file's mtime date when it carries none
+        p = tmp_path / "ladder.json"
+        p.write_text(json.dumps([
+            {"op": "decode_attn_b1_h8/4_s256_d64_bfloat16",
+             "pallas_us": 1.0, "xla_us": 2.0, "interpret": True,
+             "round": "BENCH_r99"},
+            {"op": "ragged_attn_b1_h8/4_s256_d64_bfloat16",
+             "pallas_us": 3.0, "xla_us": 1.0, "interpret": True},
+        ]))
+        monkeypatch.setenv("IPEX_LLM_TPU_DISPATCH_LADDER", str(p))
+        dispatch.clear_cache()
+        prov = dispatch.ladder_provenance()
+        assert prov["source"] == str(p)
+        if prov["platform"] == "cpu":
+            assert prov["families"]["decode_attn"]["recorded"] \
+                == "BENCH_r99"
+            assert prov["families"]["decode_attn"]["prefers"] == "pallas"
+            assert prov["families"]["ragged_attn"]["recorded"].startswith(
+                "override:ladder.json@")
+    finally:
+        monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+        dispatch.clear_cache()
+
+
+def test_bench_perf_stamp_shape(cfg_params):
+    from benchmark.serving_bench import _perf_stamp
+
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=32))
+    _drive(eng, Request(prompt_ids=_prompts(1, 8)[0], max_new_tokens=4))
+    stamp = _perf_stamp(eng)
+    assert stamp["compiles_warm"] == 0
+    assert stamp["compiles_out_of_grid"] == 0
+    assert stamp["mfu"] is None or stamp["mfu"] > 0
+    eng2 = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=32, prefill_bucket=32,
+        perfwatch=False))
+    assert _perf_stamp(eng2) == {"mfu": None, "compiles_warm": None}
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+def _serve(srv):
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(srv.app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return holder["port"], loop
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30).read().decode()
+
+
+class _Tok:
+    eos_token_id = None
+    chat_template = None
+
+    def __call__(self, text):
+        return {"input_ids": [int(x) % 131 if x.isdigit() else 1
+                              for x in text.split()]}
+
+    def decode(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+def test_health_metrics_perf_surface_e2e(cfg_params):
+    """/health carries the perf block (families + sentinel + roofline)
+    and the dispatch ladder-provenance block; /metrics carries the
+    perf_* counters and the per-family attribution histogram series."""
+    pytest.importorskip("aiohttp")
+    from ipex_llm_tpu.serving.api_server import OpenAIServer
+
+    cfg, params = cfg_params
+    eng = ServingEngine(cfg, params, EngineConfig(
+        max_rows=4, max_seq_len=256, page_size=32,
+        prefill_bucket=32)).start()
+    srv = OpenAIServer(eng, _Tok(), "tiny")
+    port, _ = _serve(srv)
+    try:
+        body = json.dumps({"prompt": "1 2 3 4 5 6 7 8",
+                           "max_tokens": 4, "temperature": 0.0}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"}), timeout=60)
+
+        h = json.loads(_get(port, "/health"))
+        perf = h["perf"]
+        assert perf["sentinel"]["compiles_warm"] == 0
+        assert perf["sentinel"]["compiles_out_of_grid"] == 0
+        assert perf["sentinel"]["grid_locked"] >= 30
+        assert perf["ticks_attributed"] >= 1
+        assert any(f.startswith("tick.") for f in perf["families"])
+        assert perf["roofline"]["peak_flops"] > 0
+        disp = h["dispatch"]
+        assert disp["source"] == "builtin"
+        assert all("recorded" in f for f in disp["families"].values())
+
+        text = _get(port, "/metrics")
+        assert "ipex_llm_tpu_perf_compiles_total" in text
+        assert "ipex_llm_tpu_perf_compiles_warm" in text
+        assert "ipex_llm_tpu_perf_ticks_attributed" in text
+        assert "_bucket" in text
+        js = json.loads(_get(port, "/metrics?format=json"))
+        assert js["metrics"]["perf_compiles_warm"] == 0
+        perf_hists = [k for k in js["histograms"]
+                      if k.startswith("perf_tick")]
+        assert perf_hists
+        for k in perf_hists:
+            assert js["histograms"][k]["count"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_router_fleet_sums_perf_counters(cfg_params):
+    """The router's /metrics aggregation fleet-SUMS the sentinel
+    counters across replicas (they are true counters) and re-labels the
+    per-replica series."""
+    pytest.importorskip("aiohttp")
+    from ipex_llm_tpu.serving.api_server import OpenAIServer
+    from ipex_llm_tpu.serving.router import HTTPBackend, Router, \
+        RouterConfig
+
+    cfg, params = cfg_params
+    engines, ports = [], []
+    for _ in range(2):
+        eng = ServingEngine(cfg, params, EngineConfig(
+            max_rows=4, max_seq_len=256, page_size=32,
+            prefill_bucket=32)).start()
+        engines.append(eng)
+        port, _ = _serve(OpenAIServer(eng, _Tok(), "tiny"))
+        ports.append(port)
+    try:
+        for port in ports:
+            body = json.dumps({"prompt": "1 2 3 4", "max_tokens": 2,
+                               "temperature": 0.0}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=60)
+        router = Router(
+            [HTTPBackend(f"http://127.0.0.1:{p}") for p in ports],
+            RouterConfig())
+        async def go():
+            text = await router.metrics_text()
+            for r in router.replicas:
+                await r.backend.close()
+            return text
+
+        loop = asyncio.new_event_loop()
+        try:
+            text = loop.run_until_complete(go())
+        finally:
+            loop.close()
+        expect = sum(e.perf.compiles["compiles_total"] for e in engines)
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("ipex_llm_tpu_fleet_perf_compiles_total")]
+        assert line and float(line[0].split()[-1]) == expect
+        assert any(ln.startswith("ipex_llm_tpu_fleet_perf_compiles_warm")
+                   for ln in text.splitlines())
+        # per-replica labelled series survive beside the sums
+        assert 'ipex_llm_tpu_perf_compiles_total{replica="0"' in text
+    finally:
+        for eng in engines:
+            eng.stop()
