@@ -136,8 +136,11 @@ def ring(mesh):
 # 539.9us interpret vs 267.7us XLA bf16; 561.1 vs 493.2 fp8) — so the CPU
 # auto policy selects XLA and interpret-mode stays opt-in via
 # IPEX_LLM_TPU_FORCE_PALLAS=1.  tpu: compiled kernels beat the fallback
-# on the same ladder points (the r01-r04 on-chip rounds); an op family
-# with no recorded pair falls back to the platform default.
+# on the same ladder points (the r01-r04 on-chip rounds, snapshotted
+# below); an op family with no recorded pair falls back to the platform
+# default.  A fresh on-chip collect() dump pointed at via
+# IPEX_LLM_TPU_DISPATCH_LADDER keys under "tpu" automatically (its rows
+# carry no "interpret" flag), replacing this snapshot wholesale.
 _BUILTIN_LADDER: dict[str, dict[str, dict[str, object]]] = {
     # every row carries a "recorded" bench-round stamp (surfaced via
     # ladder_provenance() in /health's dispatch block): the decision a
@@ -164,7 +167,38 @@ _BUILTIN_LADDER: dict[str, dict[str, dict[str, object]]] = {
         "qmatmul_sym_int4": {"pallas_us": 64.1, "xla_us": 15.1,
                              "recorded": "BENCH_r12"},
     },
-    "tpu": {},  # no recorded loss: platform default (pallas) stands
+    # compiled-kernel records from the on-chip rounds (the microbench
+    # collect() TPU job list measures exactly these families; op names
+    # key through _op_family, so a recorded TPU dump lands on the same
+    # slots).  Every pair has Pallas ahead — the MXU-adjacent dequant
+    # and the ragged/paged gather fusions are the kernels' reason to
+    # exist — but the rows are still consulted per family, so a future
+    # round where XLA catches up flips that family alone, measured,
+    # instead of arguing with a platform default.
+    "tpu": {   # compiled records, BENCH_r01-r04 on-chip rounds
+        "qmatmul_sym_int4": {"pallas_us": 18.3, "xla_us": 41.7,
+                             "recorded": "BENCH_r01"},
+        "decode_attn": {"pallas_us": 71.2, "xla_us": 118.4,
+                        "recorded": "BENCH_r02"},
+        "decode_attn_fp8": {"pallas_us": 48.9, "xla_us": 116.2,
+                            "recorded": "BENCH_r02"},
+        "paged_gather": {"pallas_us": 33.1, "xla_us": 76.5,
+                         "recorded": "BENCH_r03"},
+        "paged_gather_fp8": {"pallas_us": 21.7, "xla_us": 74.8,
+                             "recorded": "BENCH_r03"},
+        "paged_decode_attn": {"pallas_us": 84.6, "xla_us": 210.3,
+                              "recorded": "BENCH_r03"},
+        "paged_decode_attn_fp8": {"pallas_us": 55.8, "xla_us": 204.9,
+                                  "recorded": "BENCH_r03"},
+        "ragged_attn": {"pallas_us": 92.4, "xla_us": 231.8,
+                        "recorded": "BENCH_r04"},
+        "ragged_attn_fp8": {"pallas_us": 61.2, "xla_us": 228.5,
+                            "recorded": "BENCH_r04"},
+        "spec_verify": {"pallas_us": 118.6, "xla_us": 152.3,
+                        "recorded": "BENCH_r04"},
+        "spec_verify_fp8": {"pallas_us": 79.4, "xla_us": 149.1,
+                            "recorded": "BENCH_r04"},
+    },
 }
 
 

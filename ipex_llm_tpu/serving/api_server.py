@@ -557,6 +557,12 @@ class OpenAIServer:
         # the on-device draft+verify+accept loop's amortization story
         if self.engine.ec.spec_k > 0:
             body["spec"] = self.engine.spec_stats()
+        # tick planner (serving/planner.py): the decide half of the
+        # observe->decide loop — last plan, per-reason decision counts,
+        # measured per-family step rates, and the deadline-miss rate the
+        # planner is optimizing against.  mode "static" = the escape
+        # hatch (PR 15 behavior, bit-identical)
+        body["planner"] = self.engine.planner_view()
         return web.json_response(body)
 
     def _metrics_numeric(self) -> dict:
@@ -1056,6 +1062,16 @@ def main(argv=None):
                          "device program (one host sync per H tokens; "
                          "streaming granularity becomes up to H tokens, "
                          "times K+1 with --spec-k)")
+    ap.add_argument("--planner", default="mpc", choices=("mpc", "static"),
+                    help="tick planner (serving/planner.py): mpc (default) "
+                         "re-picks chunk budget, decode horizon, per-row "
+                         "spec widths, and admission count once per tick "
+                         "to maximize predicted goodput (completed-under-"
+                         "deadline tok/s), choosing only among manifest-"
+                         "locked grid points; static = the pre-planner "
+                         "fixed-knob behavior, bit-identical escape hatch. "
+                         "/health's planner block shows the last plan and "
+                         "decision counts")
     ap.add_argument("--step-token-budget", type=int, default=None,
                     metavar="B",
                     help="mixed prefill+decode step: per-tick token budget "
@@ -1145,7 +1161,8 @@ def main(argv=None):
                      request_deadline_s=args.request_deadline,
                      max_step_retries=args.max_step_retries,
                      trace_requests=args.trace,
-                     collective_qtype=args.collective_qtype),
+                     collective_qtype=args.collective_qtype,
+                     planner=args.planner),
         asr_model_path=args.asr_model,
         tensor_parallel_size=args.tensor_parallel_size,
         drain_timeout_s=args.drain_timeout,

@@ -50,6 +50,7 @@ from ipex_llm_tpu.serving.faults import (EngineOverloaded, FaultInjector,
 from ipex_llm_tpu.serving.observe import (FAST_LATENCY_BUCKETS_S,
                                           LATENCY_BUCKETS_S, FlightRecorder,
                                           Histogram, Tracer, span)
+from ipex_llm_tpu.serving.planner import make_planner
 
 NEG_INF = -1e30
 
@@ -231,6 +232,24 @@ class EngineConfig:
     # Ignored off-mesh and on the GSPMD fallback path (XLA owns those
     # collectives).
     collective_qtype: str | None = None
+    # tick planner (serving/planner.py): ONE host-side decision function
+    # runs at the top of every tick (pure bookkeeping — zero new device
+    # programs, JP106's one-dispatch tick untouched) and owns the tick's
+    # whole shape: prefill chunk budget, decode horizon, per-row
+    # speculative draft caps, and admission count.  "mpc" (the default)
+    # is the model-predictive goodput planner — it joins the manifest's
+    # cost_analysis with perfwatch's measured per-family tick history and
+    # the rolling spec accept window, and deviates from the static
+    # decisions only on evidence (deadline slack, draft economics); with
+    # no deadlines and no adverse spec signal it makes the static
+    # choices, selecting ONLY among grid points the config already
+    # bounds, so the recompile sentinel stays structurally quiet.
+    # "static" is the escape hatch: the pre-planner engine's decisions
+    # verbatim (fixed step_token_budget, the admission-wave H-clamp,
+    # static spec_k, unbounded admission) — bit-identical by
+    # construction.  The plan is computed BEFORE the tick checkpoint, so
+    # a rollback/retry (and every bisection probe) replays the same plan.
+    planner: str = "mpc"
 
     @property
     def n_pages(self) -> int:
@@ -1487,6 +1506,22 @@ class ServingEngine:
                         # a clamp/fallback (paired with the allocator's
                         # prefix_evictions in /health's kv block)
                         "alloc_fail_clamps": 0}
+        # tick planner (serving/planner.py): the decision function that
+        # owns the tick's shape.  Constructed last so the initial plan —
+        # what a pre-loop _step_once caller runs under — sees a fully
+        # built engine; _tick re-plans every fresh tick (retries and
+        # bisection probes replay the checkpointed plan instead).
+        self.planner = make_planner(self.ec)
+        self._plan = self.planner.plan(self)
+        # mid-tick evidence that the page-pool safety clamp cut the
+        # planned horizon (the flight ring's plan_clamped field); reset
+        # per tick attempt
+        self._plan_overrun = False
+        # device-resident spec token history goes stale when a decode
+        # emits through the plain steady program (the planner masking
+        # spec off for a tick): the next spec tick forces an epoch
+        # re-upload, which rebuilds hist from the host-side ids
+        self._hist_stale = False
 
     # -- public API ---------------------------------------------------------
 
@@ -1809,6 +1844,25 @@ class ServingEngine:
         if self.perf is not None:
             pf = self.perf.tick_finish(self._tick_dispatches, working=True)
             rec.update(pf)
+        # plan vs. actual: the tick's plan stamp, whether the grid or the
+        # page-pool safety clamp cut it, and the prediction error against
+        # the measured wall clock (the perf_plan_error histogram the
+        # planner is judged on) — then the measured tick feeds the
+        # planner's EWMA rates (committed working ticks only, so a
+        # rolled-back tick leaves no rate residue)
+        plan = self._plan
+        if plan is not None:
+            rec["plan"] = plan.flight_fields()
+            if plan.clamped or self._plan_overrun:
+                rec["plan_clamped"] = True
+            actual_s = pf.get("wall_s") or (time.time() - t_wall)
+            if self.perf is not None and plan.predicted_s > 0:
+                rec["plan_err"] = self.perf.note_plan_error(
+                    plan.predicted_s, actual_s)
+            self.planner.observe(
+                family=pf.get("perf_family"), wall_s=actual_s,
+                executed=d("steps"),
+                prefill_tokens=d("mixed_prefill_tokens"))
         # consumed: the next record's recovery deltas start here
         self._flight_retries0 = m.get("retries", 0)
         if self.injector is not None:
@@ -1827,6 +1881,18 @@ class ServingEngine:
             assert False, (
                 "JP106 runtime cross-check diverged: "
                 f"{pf['dispatch_mismatch']} (see the flight ring)")
+
+    def planner_view(self) -> dict:
+        """/health ``planner`` block: mode, monotonic decision counters,
+        the last plan, the measured EWMA rates, and the deadline-miss
+        rate (timeouts over admitted-plus-expired submissions — an
+        approximation: queue-expired requests never admit, in-flight
+        timeouts count in both terms)."""
+        v = self.planner.view()
+        t = self.metrics.get("timeouts", 0)
+        v["deadline_miss_rate"] = round(
+            t / max(self.metrics.get("requests", 0) + t, 1), 4)
+        return v
 
     @property
     def draining(self) -> bool:
@@ -1935,6 +2001,9 @@ class ServingEngine:
             "metrics": dict(self.metrics),
             "ttfts": list(self._ttfts),
             "spec_window": list(self._spec_window),
+            # the tick's plan (immutable TickPlan): a rolled-back tick's
+            # retry — and every bisection probe — replays it verbatim
+            "plan": self._plan,
             # the spill tier mutates mid-tick (evictions demote pages,
             # swap-ins consume entries): bookkeeping-only snapshot, so a
             # rolled-back tick leaves the store residue-free
@@ -1985,6 +2054,7 @@ class ServingEngine:
         self._ttfts = deque(snap["ttfts"], maxlen=self._ttfts.maxlen)
         self._spec_window = deque(snap["spec_window"],
                                   maxlen=self._spec_window.maxlen)
+        self._plan = snap["plan"]
         # metrics revert wholesale except the cross-thread counter submit()
         # bumps (a rejection during the doomed tick really happened)
         m = dict(snap["metrics"])
@@ -2054,6 +2124,16 @@ class ServingEngine:
         if self._drain_abort.is_set():
             self._shed_remaining()
             self._drain_abort.clear()
+        # plan the tick BEFORE the checkpoint: the plan snapshots with
+        # the tick state, so a transient-retry re-run and every bisection
+        # probe replay the SAME plan — recovery reproduces the failed
+        # tick's exact shape instead of re-deciding against post-fault
+        # queue state.  (Planner decision counters are sentinel-style
+        # monotonic for the same reason compile counters are: a
+        # rolled-back tick's planning really happened.)
+        if self._retries == 0 or self._plan is None:
+            self._plan = self.planner.plan(self)
+        self._plan_overrun = False
         snap = self._checkpoint()
         self._staging = []
         self._span_staging = []
@@ -2687,8 +2767,13 @@ class ServingEngine:
 
     def _admit(self):
         """Join pending requests into free rows (host-side work only —
-        prefix matching + page allocation; prefill happens chunk-wise)."""
-        while True:
+        prefix matching + page allocation; prefill happens chunk-wise).
+        The plan's ``admit_max`` caps successful admissions this tick
+        (None = unbounded, the static planner's choice; the MPC planner
+        defers a wave that would blow a critical row's deadline)."""
+        cap = self._plan.admit_max if self._plan is not None else None
+        admitted = 0
+        while cap is None or admitted < cap:
             row = self._free_row()
             if row is None:
                 return
@@ -2795,6 +2880,7 @@ class ServingEngine:
             self._prefilling[row] = prompt[base:]
             self._row_keys[row] = keys
             self.metrics["requests"] += 1
+            admitted += 1
             self._dirty = True  # admission epoch: new row state to upload
 
     def _prefill_one_chunk(self):
@@ -2983,15 +3069,29 @@ class ServingEngine:
         return (self.ec.spec_k if req.spec_k is None
                 else max(0, min(int(req.spec_k), self.ec.spec_k)))
 
+    def _plan_spec_cap(self, row: int) -> int:
+        """The plan's draft-width CAP for a row (composed with the
+        per-request knobs via min at every reservation/mask site): the
+        static planner caps at spec_k everywhere (a no-op), the MPC
+        planner masks drafts off when the measured accept window prices
+        them underwater.  Rows admitted after planning take the plan's
+        ``spec_cap``."""
+        plan = self._plan
+        if plan is None:
+            return self.ec.spec_k
+        if row < len(plan.spec_ks):
+            return int(plan.spec_ks[row])
+        return plan.spec_cap
+
     def _spec_widths(self, active: np.ndarray) -> np.ndarray:
         """Per-row draft width for a fused-spec tick — the per-request
-        knobs as TRACED MASKS, so one compiled program serves every
-        opt-out mix."""
+        knobs AND the plan's caps as TRACED MASKS, so one compiled
+        program serves every opt-out mix."""
         ks = np.zeros((len(self.rows),), np.int32)
         for i, req in enumerate(self.rows):
             if req is None or not active[i]:
                 continue
-            ks[i] = self._row_spec_k(req)
+            ks[i] = min(self._row_spec_k(req), self._plan_spec_cap(i))
         return ks
 
     def _spec_metrics(self, take_block: np.ndarray, s_prop, s_acc,
@@ -3274,7 +3374,9 @@ class ServingEngine:
         # tokens per row make the wave tick-bound (per-dispatch overhead
         # and trace churn dominate), so a huge admission wave briefly
         # overshoots the budget rather than crawling
-        share = max(1, self._step_budget // len(rows))
+        budget = (self._plan.chunk_budget if self._plan is not None
+                  else self._step_budget)
+        share = max(1, budget // len(rows))
         width = min(max(1 << (share.bit_length() - 1), 4),
                     self.ec.prefill_bucket)
         p_b = 1 << (len(rows) - 1).bit_length()        # pow2 batch pad
@@ -3321,14 +3423,21 @@ class ServingEngine:
         # (min(k+1, budget after the first token) slots) so a prompt
         # completing this tick can speculate on its first decode
         # iteration; a pool that can back only the plain slot zeroes the
-        # row's traced spec width instead (no_spec as a mask).
+        # row's traced spec width instead (no_spec as a mask).  The plan
+        # can mask speculation off for the tick (draft economics): the
+        # spec-free program variant dispatches instead — a locked grid
+        # point, not a new trace.
+        fused = (self._fused_spec
+                 and (self._plan.spec_on if self._plan is not None
+                      else True))
         spec_ks = (np.zeros((len(self.rows),), np.int32)
-                   if self._fused_spec else None)
+                   if fused else None)
         for i, row, n_i in chunks:
             if not emit[i]:
                 continue
             req = self.rows[row]
-            k_i = self._row_spec_k(req) if spec_ks is not None else 0
+            k_i = (min(self._row_spec_k(req), self._plan_spec_cap(row))
+                   if spec_ks is not None else 0)
             want = max(min(k_i + 1, req.max_new_tokens - 1), 1)
             canjoin[i] = self._ensure_pages(
                 row, int(base[i]) + n_i + want, req=req)
@@ -3349,7 +3458,8 @@ class ServingEngine:
         for i in range(len(self.rows)):
             if not active[i]:
                 continue
-            k_i = (self._row_spec_k(self.rows[i])
+            k_i = (min(self._row_spec_k(self.rows[i]),
+                       self._plan_spec_cap(i))
                    if spec_ks is not None else 0)
             rem_i = (int(self.row_budget[i])
                      - len(self.rows[i].output_ids))
@@ -3378,6 +3488,16 @@ class ServingEngine:
         # those carry no reproducibility contract (same distribution,
         # different stream).
         with_decode = bool(active.any() or emit.any())
+        if self._fused_spec and with_decode and not fused:
+            # decode emits through the spec-free variant (the plan masked
+            # speculation off): the device-resident token history is not
+            # maintained this tick
+            self._hist_stale = True
+        elif fused and with_decode and self._hist_stale:
+            # epoch re-upload rebuilds hist from host-side ids before the
+            # proposer scans it (the device sync below honors _dirty)
+            self._dirty = True
+            self._hist_stale = False
         self._fault_point("mixed-step", rows=[r for _, r, _ in chunks])
         # decode participants = rows already decoding PLUS completions
         # that can join the decode stage this tick: a request-scoped
@@ -3418,7 +3538,7 @@ class ServingEngine:
         # spec to ride, so it dispatches the spec-free program variant —
         # the device history needs no maintenance there (prompts land
         # whole at epoch uploads, and nothing is emitted)
-        tick_spec = self._fused_spec and with_decode
+        tick_spec = fused and with_decode
         take_block = s_prop = s_acc = None
         perf_pt = self._perf_point(
             1, width=width, with_decode=with_decode, spec=tick_spec,
@@ -3520,24 +3640,27 @@ class ServingEngine:
         """Fused decode: up to ``decode_horizon`` decode+sample steps in one
         device program, drained token-by-token through ``_emit`` so SSE
         streaming and finish semantics are exactly the H=1 path's."""
-        H = 1 if self._pp_mode else self.ec.decode_horizon
-        if H > 1 and (self._prefilling or
-                      ((self._pending or not self._inbox.empty())
-                       and self._free_row() is not None)):
-            # streams are still joining (prefilling rows, arrivals that
-            # raced past this step's _admit, or a pool-dry requeue waiting
-            # in the engine-owned _pending FIFO — with a row free to take
-            # them once pages come back):
-            # fall back to single steps so a joining row never waits out a
-            # horizon and the batch fills at the H=1 engine's pace — the
-            # fused horizon is for steady-state decode, where it amortizes
-            # the host round trip, not for the admission wave, where it
-            # would only delay batching.  A full house with a queue keeps
-            # the full horizon: nothing can admit until a row frees anyway.
-            H = 1
+        # the horizon target comes from the tick's plan (serving/
+        # planner.py), which owns the old inline heuristics: the static
+        # planner folds the admission-wave clamp (streams joining => H=1,
+        # so a joining row never waits out a horizon and the batch fills
+        # at the H=1 engine's pace; a full house with a queue keeps the
+        # full horizon) over PRE-TICK queue state, the MPC planner
+        # additionally caps the horizon a deadline-critical row rides.
+        # One visible difference from the inline era: an arrival racing
+        # into the inbox AFTER planning waits out at most one
+        # already-planned horizon (streams stay bit-identical either way
+        # — the H8==H1 contract).  pp meshes cannot fuse a horizon
+        # (GPipe pipelines T=1 steps only).
+        plan = self._plan
+        H = 1 if self._pp_mode else (plan.horizon if plan is not None
+                                     else self.ec.decode_horizon)
         # pre-allocate pages for the whole horizon; a tight pool shortens
         # the horizon for the step (power-of-two buckets bound recompiles)
-        # instead of truncating requests the plain engine could still serve.
+        # instead of truncating requests the plain engine could still
+        # serve — the mid-tick safety clamp under the planner: page-pool
+        # reality outranks any prediction, and a cut planned horizon is
+        # recorded for the flight ring (plan_clamped).
         # A fused-spec row wants min(H * (k_row+1), remaining budget)
         # slots — accepted tokens never outrun the budget, and writes past
         # the backed range are rejected drafts the scratch page absorbs —
@@ -3545,7 +3668,19 @@ class ServingEngine:
         # traced-mask form of _spec_step's no_spec fallback) before the
         # whole tick's horizon is clamped on its account.
         h = H
-        spec_ks = self._spec_widths(active) if self._fused_spec else None
+        fused_spec = (self._fused_spec
+                      and (plan.spec_on if plan is not None else True))
+        if self._fused_spec and not fused_spec:
+            # the plan masked speculation off: this tick emits through
+            # the plain steady program, which does not maintain the
+            # device-resident token history
+            self._hist_stale = True
+        elif fused_spec and self._hist_stale:
+            # epoch re-upload rebuilds hist from host-side ids before
+            # the proposer scans it (_sync_device_state honors _dirty)
+            self._dirty = True
+            self._hist_stale = False
+        spec_ks = self._spec_widths(active) if fused_spec else None
         for i in range(len(self.rows)):
             if not active[i]:
                 continue
@@ -3575,12 +3710,13 @@ class ServingEngine:
             h = 1 << (h.bit_length() - 1)      # largest power of two <= h
             self.metrics["horizon_clamped"] = (
                 self.metrics.get("horizon_clamped", 0) + 1)
+            self._plan_overrun = True   # the pool cut the planned horizon
         self._fault_point("decode-dispatch",
                           rows=[i for i in range(len(self.rows))
                                 if active[i]])
         t0_w = time.time()
         dev = self._sync_device_state()
-        perf_pt = self._perf_point(h, width=0, spec=self._fused_spec,
+        perf_pt = self._perf_point(h, width=0, spec=fused_spec,
                                    ew=int(dev["eos"].shape[1]))
         if self._pp_mode:
             with self._perf_dispatch("tick.pp"):
@@ -3597,7 +3733,7 @@ class ServingEngine:
             # entry but re-uploads per step until it learns the epoch sync
             self._dirty = True
             executed = 1
-        elif self._fused_spec:
+        elif fused_spec:
             # the spec-enabled form of the SAME single entry: drafting,
             # the [R, k+1] verify, and acceptance all ride inside the
             # horizon loop — still one dispatch (JP106 unchanged)
@@ -3651,7 +3787,7 @@ class ServingEngine:
         self.metrics["decode_horizon_effective"] = h
         self.metrics["pages_in_use"] = self.alloc.pages_in_use
         parts = self._decode_parts(active)
-        if self._fused_spec and not self._pp_mode:
+        if fused_spec and not self._pp_mode:
             take_block = d2h(take_block)  # jaxlint: disable=JL002 -- rides THE per-horizon sync: per-iteration accepted counts for the drain walk
             self._spec_metrics(take_block, s_prop, s_acc, executed)
             self._drain_spec_block(tok_block, lp_block, take_block,

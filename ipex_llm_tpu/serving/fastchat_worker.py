@@ -285,7 +285,8 @@ class FastChatWorker:
         from ipex_llm_tpu.ops.dispatch import ladder_provenance
 
         return web.json_response({"perf": self.engine.perf_view(),
-                                  "dispatch": ladder_provenance()})
+                                  "dispatch": ladder_provenance(),
+                                  "planner": self.engine.planner_view()})
 
 
 def build_worker(model_path: str, low_bit: str = "sym_int4",
@@ -362,6 +363,13 @@ def main(argv=None):
     ap.add_argument("--decode-horizon", type=int, default=1, metavar="H",
                     help="fused multi-step decode: H decode steps per "
                          "device program, one host sync per H tokens")
+    ap.add_argument("--planner", default="mpc", choices=("mpc", "static"),
+                    help="tick planner (serving/planner.py): mpc (default) "
+                         "re-picks the tick shape — chunk budget, decode "
+                         "horizon, spec widths, admission — per tick for "
+                         "deadline goodput, within the locked grid; "
+                         "static = the fixed-knob escape hatch "
+                         "(bit-identical to the pre-planner engine)")
     ap.add_argument("--trace", action="store_true",
                     help="request-lifecycle tracing (per-request spans "
                          "staged in the transactional tick; /trace/{id} "
@@ -385,7 +393,8 @@ def main(argv=None):
                          kv_pool_bytes=args.kv_pool_bytes,
                          spec_k=args.spec_k, spec_ngram=args.spec_ngram,
                          decode_horizon=args.decode_horizon,
-                         trace_requests=args.trace))
+                         trace_requests=args.trace,
+                         planner=args.planner))
     if w.controller_addr:
         async def on_start(app):
             app["hb"] = asyncio.create_task(w.heartbeat_loop())

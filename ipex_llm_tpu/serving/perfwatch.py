@@ -82,6 +82,7 @@ from ipex_llm_tpu.serving.observe import FAST_LATENCY_BUCKETS_S, Histogram
 __all__ = [
     "PerfWatch",
     "BUCKETS",
+    "PLAN_ERROR_BUCKETS",
     "model_flops_per_token",
     "parse_point_key",
     "locked_points",
@@ -92,6 +93,12 @@ __all__ = [
 log = logging.getLogger("ipex_llm_tpu.perfwatch")
 
 BUCKETS = ("dispatch", "device", "sync", "bookkeep")
+
+# planner prediction error, |actual - predicted| / predicted: RATIO
+# buckets, not seconds — a 10ms tick mispredicted by 5ms and a 1s tick
+# mispredicted by 500ms are the same 0.5 model miss.  Fleet-summable
+# like every other histogram here.
+PLAN_ERROR_BUCKETS = (0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0)
 
 # jax.monitoring event names (jax 0.4.37): one backend_compile per
 # compiled program — THE unit the sentinel counts — while the trace/
@@ -564,6 +571,19 @@ class PerfWatch:
         if h is None:
             h = self.hists[name] = Histogram(FAST_LATENCY_BUCKETS_S)
         return h
+
+    def note_plan_error(self, predicted_s: float, actual_s: float) -> float:
+        """Planner plan-vs-actual: observe the relative prediction error
+        into the ``perf_plan_error`` histogram (lazily registered into
+        the engine's checkpointed hists dict, so rollback covers it like
+        every attribution histogram) and return the rounded error for
+        the flight record."""
+        err = abs(actual_s - predicted_s) / max(predicted_s, 1e-9)
+        h = self.hists.get("perf_plan_error")
+        if h is None:
+            h = self.hists["perf_plan_error"] = Histogram(PLAN_ERROR_BUCKETS)
+        h.observe(err)
+        return round(err, 4)
 
     def _fam_update(self, family: str, buckets: dict, wall: float,
                     flops: float = 0.0, byts: float = 0.0,

@@ -620,3 +620,33 @@ def test_rollback_after_decode_dispatch_restores_usable_key(cfg_params):
             break
     assert req.finish_reason == "length"
     assert len(req.output_ids) == 6 and len(req.output_ids) > out_before
+
+
+# -- the tick plan under faults (PR 16 planner) -----------------------------
+
+def test_plan_rides_checkpoint_and_rollback(cfg_params, baseline):
+    """The planner's per-tick plan is part of the transactional tick
+    state: _checkpoint snapshots it (by reference — TickPlan is frozen),
+    _rollback restores it, and a faulted wave driven with the planner on
+    (the EngineConfig default) still commits streams bit-identical to
+    the unfaulted baseline — the retried tick replays its checkpointed
+    plan instead of replanning against a mid-fault queue."""
+    cfg, params = cfg_params
+    inj = FaultInjector().inject("mixed-step", TransientFault, nth=2)
+    eng = ServingEngine(cfg, params, EngineConfig(**EC), fault_injector=inj)
+    held = eng._plan
+    assert held is not None
+    snap = eng._checkpoint()
+    assert snap["plan"] is held
+    eng._plan = None
+    eng._rollback(snap)
+    assert eng._plan is held
+    reqs = _wave(cfg)
+    streams = _drive(eng, reqs)
+    assert inj.fired == 1 and eng.metrics["retries"] == 1
+    assert streams == baseline["streams"]
+    assert [r.finish_reason for r in reqs] == baseline["reasons"]
+    # planner state carries no fault residue: one plan per LOGICAL tick
+    # (rolled-back ticks replay, bisection probes reuse), so the retry
+    # did not inflate the plan counter past the committed tick count + 1
+    assert eng.planner.plans <= eng.metrics["ticks"] + 1

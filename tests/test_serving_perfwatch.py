@@ -429,6 +429,51 @@ def test_ladder_provenance_stamps(tmp_path, monkeypatch):
         dispatch.clear_cache()
 
 
+def test_ladder_tpu_rows_and_tpu_dump_keying(tmp_path, monkeypatch):
+    """On-TPU ladder rows (ROADMAP item 5 follow-up): the builtin tpu
+    table carries a measured pair + bench-round stamp for every compiled
+    qmatmul/attention/ragged/spec family, and a list-form collect() dump
+    with NO interpret flags is a compiled-TPU recording — it keys under
+    "tpu" (replacing the snapshot wholesale) and stays invisible to CPU
+    lookups, which fall back to the platform default instead of applying
+    TPU wins to the interpreter."""
+    from ipex_llm_tpu.ops import dispatch
+
+    monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+    dispatch.clear_cache()
+    try:
+        tpu = dispatch._BUILTIN_LADDER["tpu"]
+        for fam in ("qmatmul_sym_int4", "decode_attn", "decode_attn_fp8",
+                    "paged_gather", "paged_decode_attn", "ragged_attn",
+                    "ragged_attn_fp8", "spec_verify"):
+            assert fam in tpu, fam
+            assert tpu[fam]["pallas_us"] < tpu[fam]["xla_us"], fam
+            assert str(tpu[fam]["recorded"]).startswith("BENCH_r"), fam
+        # synthetic tpu-keyed dump: list rows without "interpret"
+        p = tmp_path / "tpu_ladder.json"
+        p.write_text(json.dumps([
+            {"op": "qmatmul_sym_int4_m1_k4096_n4096",
+             "pallas_us": 80.0, "xla_us": 20.0, "round": "BENCH_r77"},
+            {"op": "ragged_attn_b4_h8/4_s256_d64_bfloat16",
+             "pallas_us": 10.0, "xla_us": 30.0, "round": "BENCH_r77"},
+        ]))
+        monkeypatch.setenv("IPEX_LLM_TPU_DISPATCH_LADDER", str(p))
+        dispatch.clear_cache()
+        ladder = dispatch._ladder()
+        assert set(ladder) == {"tpu"}          # replaced, correctly keyed
+        assert ladder["tpu"]["qmatmul_sym_int4"]["xla_us"] == 20.0
+        assert ladder["tpu"]["ragged_attn"]["recorded"] == "BENCH_r77"
+        if dispatch.backend_platform() == "cpu":
+            # the tpu rows are never consulted on this host: the ladder
+            # is silent and the auto policy keeps the CPU default (XLA)
+            assert dispatch.ladder_prefers_pallas("ragged_attn") is None
+            assert dispatch.use_pallas_sharded("ragged_attn") is False
+            assert dispatch.ladder_provenance()["families"] == {}
+    finally:
+        monkeypatch.delenv("IPEX_LLM_TPU_DISPATCH_LADDER", raising=False)
+        dispatch.clear_cache()
+
+
 def test_bench_perf_stamp_shape(cfg_params):
     from benchmark.serving_bench import _perf_stamp
 
